@@ -1,0 +1,76 @@
+"""Benchmark driver: AlexNet training throughput on the available TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline derivation (BASELINE.md): the reference repo records no numbers;
+the driver-defined target is "v5e-16 >= 4x V100 + NCCL" on AlexNet.  A
+V100 trains reference-config AlexNet (bs 64/gpu, 3x229x229, f32, cuDNN) at
+~1.5k samples/s, so 4xV100 ~= 6k samples/s and the per-chip parity bar on
+a 16-chip pod is 6000/16 = 375 samples/s/chip.  vs_baseline reported here
+is measured samples/s/chip divided by that 375 bar.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+PER_CHIP_BASELINE = 375.0  # samples/s/chip parity bar (see module docstring)
+
+
+def run(batch_size=256, epochs=3, iters_per_epoch=8, compute_dtype="bfloat16"):
+    import jax
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    n_dev = len(jax.devices())
+    cfg = ff.FFConfig(batch_size=batch_size, compute_dtype=compute_dtype)
+    model = ff.FFModel(cfg)
+    inp, _ = build_alexnet(model, cfg.batch_size)
+    model.compile(ff.SGDOptimizer(model, lr=0.001),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    dl = ff.DataLoader.synthetic(model, inp, num_samples=batch_size)
+    model.init_layers()
+
+    # Compile + warmup.
+    dl.next_batch(model)
+    model.train_iteration()
+    model.sync()
+
+    t0 = time.perf_counter()
+    steps = epochs * iters_per_epoch
+    for _ in range(steps):
+        model.train_iteration()
+    model.sync()
+    dt = time.perf_counter() - t0
+    throughput = steps * batch_size / dt
+    return throughput, n_dev
+
+
+def main():
+    try:
+        throughput, n_dev = run()
+        per_chip = throughput / max(1, n_dev)
+        print(json.dumps({
+            "metric": "alexnet_train_samples_per_sec_per_chip",
+            "value": round(per_chip, 2),
+            "unit": "samples/s/chip",
+            "vs_baseline": round(per_chip / PER_CHIP_BASELINE, 3),
+        }))
+    except Exception as e:  # never leave the driver without a line
+        print(json.dumps({
+            "metric": "alexnet_train_samples_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "samples/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        raise
+
+
+if __name__ == "__main__":
+    main()

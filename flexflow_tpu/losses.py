@@ -1,0 +1,77 @@
+"""Loss functions with reference-exact gradient semantics.
+
+TPU-native analogue of the reference loss layer
+(reference: src/loss_functions/loss_functions.cu, include/loss_functions.h).
+
+The reference computes the loss *gradient* directly at the softmax output
+region and scales by ``1/batch_size`` (loss_functions.cu:141-150):
+  * sparse CCE: grad = probs; probs[label] -= 1   (× 1/B)
+  * CCE / MSE-avg: grad = logit - label           (× 1/B)
+
+Here losses are scalar-valued pure functions differentiated by ``jax.grad``
+— chosen so the autodiff gradient is *identical* to the reference kernels:
+  * sparse/dense CCE is computed from the **pre-softmax** activations via
+    ``log_softmax`` (the fused softmax+CE form: d/dlogits = (probs-onehot)/B
+    — exactly the reference's fused pair of softmax-forward + CE-backward).
+  * MSE-avg uses 0.5·mean over samples of the squared error, whose gradient
+    is (logit-label)/B.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class LossType:
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR_AVG_REDUCE = "mean_squared_error"
+
+
+def _canon(loss_type: str) -> str:
+    aliases = {
+        "categorical_crossentropy": LossType.CATEGORICAL_CROSSENTROPY,
+        "sparse_categorical_crossentropy": LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        "mean_squared_error": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        "mse": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+    }
+    if loss_type not in aliases:
+        raise ValueError(f"Unrecognized loss type: {loss_type}")
+    return aliases[loss_type]
+
+
+class Loss:
+    """Scalar loss over (pre-softmax logits, labels).
+
+    ``wants_logits`` tells the executor whether to feed the *input* of a
+    trailing Softmax op (the fused, numerically-stable TPU path) instead of
+    its output.
+    """
+
+    def __init__(self, loss_type: str):
+        self.loss_type = _canon(loss_type)
+
+    @property
+    def wants_logits(self) -> bool:
+        return self.loss_type in (
+            LossType.CATEGORICAL_CROSSENTROPY,
+            LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        )
+
+    def __call__(self, preds: jax.Array, labels: jax.Array) -> jax.Array:
+        """preds: (B, C) logits for CE losses, final outputs for MSE.
+        labels: (B,) or (B,1) int for sparse CE; (B, C) otherwise."""
+        preds = preds.astype(jnp.float32)
+        batch = preds.shape[0]
+        if self.loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            labels = labels.reshape(batch).astype(jnp.int32)
+            logp = jax.nn.log_softmax(preds, axis=-1)
+            nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+            return jnp.sum(nll) / batch
+        if self.loss_type == LossType.CATEGORICAL_CROSSENTROPY:
+            logp = jax.nn.log_softmax(preds, axis=-1)
+            return jnp.sum(-labels.astype(jnp.float32) * logp) / batch
+        # MSE avg-reduce: grad must be (pred-label)/B per element
+        diff = preds - labels.astype(jnp.float32)
+        return 0.5 * jnp.sum(diff * diff) / batch

@@ -1,0 +1,143 @@
+"""Training metrics.
+
+TPU-native analogue of the reference metrics layer (reference:
+src/metrics_functions/metrics_functions.{cc,cu}, include/metrics_functions.h).
+
+The reference accumulates a device-side ``PerfMetrics`` struct with atomics
+per partition, then folds per-part futures on the CPU
+(src/runtime/model.cc:1145-1167).  Here per-batch sums are computed inside
+the jitted step (XLA reduces across the mesh — the analogue of the future
+fold), returned as a small dict of scalars, and accumulated on host in a
+``PerfMetrics`` whose ``print`` mirrors PerfMetrics::print
+(metrics_functions.cc:44-70).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+LOG_MIN_VALUE = 1e-20
+
+
+class MetricsType:
+    ACCURACY = "accuracy"
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    ROOT_MEAN_SQUARED_ERROR = "root_mean_squared_error"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Host-side running totals (reference: include/metrics_functions.h:25-39)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, one: Dict[str, float]) -> None:
+        self.train_all += int(one.get("train_all", 0))
+        self.train_correct += int(one.get("train_correct", 0))
+        self.cce_loss += float(one.get("cce_loss", 0.0))
+        self.sparse_cce_loss += float(one.get("sparse_cce_loss", 0.0))
+        self.mse_loss += float(one.get("mse_loss", 0.0))
+        self.rmse_loss += float(one.get("rmse_loss", 0.0))
+        self.mae_loss += float(one.get("mae_loss", 0.0))
+
+    def reset(self) -> None:
+        self.__init__()
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct * 100.0 / max(1, self.train_all)
+
+    def to_string(self) -> str:
+        out = "[Metrics]"
+        if self.train_all > 0:
+            out += (f" accuracy: {self.accuracy:.6f}% "
+                    f"({self.train_correct} / {self.train_all})")
+        if self.cce_loss > 0:
+            out += f" categorical_crossentropy: {self.cce_loss / max(1, self.train_all):.6f}"
+        if self.sparse_cce_loss > 0:
+            out += (" sparse_categorical_crossentropy: "
+                    f"{self.sparse_cce_loss / max(1, self.train_all):.6f}")
+        if self.mse_loss > 0:
+            out += f" mean_squared_error: {self.mse_loss / max(1, self.train_all):.6f}"
+        if self.rmse_loss > 0:
+            out += f" root_mean_squared_error: {self.rmse_loss / max(1, self.train_all):.6f}"
+        if self.mae_loss > 0:
+            out += f" mean_absolute_error: {self.mae_loss / max(1, self.train_all):.6f}"
+        return out
+
+    def print(self) -> None:
+        print(self.to_string())
+
+
+class Metrics:
+    """Jit-side per-batch metric sums (reference compute kernels:
+    metrics_functions.cu:57-175).  ``probs`` is the softmax output (or raw
+    final activation when the model has no softmax); ``labels`` is int
+    (B,)/(B,1) when ``sparse`` else one-hot/regression targets (B, C)."""
+
+    def __init__(self, loss_type: str, metrics: Sequence[str]):
+        self.metrics = list(metrics)
+        self.sparse = "sparse" in loss_type
+        self.loss_type = loss_type
+
+    def compute(self, probs: jax.Array, labels: jax.Array) -> Dict[str, jax.Array]:
+        probs = probs.astype(jnp.float32)
+        batch, num_classes = probs.shape[0], probs.shape[-1]
+        out: Dict[str, jax.Array] = {"train_all": jnp.int32(batch)}
+        m = self.metrics
+        if self.sparse:
+            sl = labels.reshape(batch).astype(jnp.int32)
+            if MetricsType.ACCURACY in m:
+                pred = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+                out["train_correct"] = jnp.sum(pred == sl).astype(jnp.int32)
+            if MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY in m:
+                p = jnp.take_along_axis(probs, sl[:, None], axis=-1)
+                out["sparse_cce_loss"] = jnp.sum(-jnp.log(jnp.maximum(p, LOG_MIN_VALUE)))
+            if (MetricsType.MEAN_SQUARED_ERROR in m
+                    or MetricsType.ROOT_MEAN_SQUARED_ERROR in m
+                    or MetricsType.MEAN_ABSOLUTE_ERROR in m):
+                onehot = jax.nn.one_hot(sl, num_classes, dtype=jnp.float32)
+                diff = probs - onehot
+                mse = jnp.sum(diff * diff, axis=-1)
+                if MetricsType.MEAN_SQUARED_ERROR in m:
+                    out["mse_loss"] = jnp.sum(mse)
+                if MetricsType.ROOT_MEAN_SQUARED_ERROR in m:
+                    out["rmse_loss"] = jnp.sum(jnp.sqrt(mse))
+                if MetricsType.MEAN_ABSOLUTE_ERROR in m:
+                    out["mae_loss"] = jnp.sum(jnp.abs(diff))
+        else:
+            labels = labels.astype(jnp.float32)
+            if MetricsType.ACCURACY in m:
+                if num_classes == 1:
+                    # accuracy is meaningless for 1 output; reference returns
+                    # 100% (metrics_functions.cu:121-126)
+                    out["train_correct"] = jnp.int32(batch)
+                else:
+                    pred = jnp.argmax(probs, axis=-1)
+                    true = jnp.argmax(labels, axis=-1)
+                    out["train_correct"] = jnp.sum(pred == true).astype(jnp.int32)
+            if MetricsType.CATEGORICAL_CROSSENTROPY in m:
+                cce = -labels * jnp.log(jnp.maximum(probs, LOG_MIN_VALUE))
+                out["cce_loss"] = jnp.sum(jnp.where(labels > 0.0, cce, 0.0))
+            diff = probs - labels
+            mse = jnp.sum(diff * diff, axis=-1)
+            if MetricsType.MEAN_SQUARED_ERROR in m:
+                out["mse_loss"] = jnp.sum(mse)
+            if MetricsType.ROOT_MEAN_SQUARED_ERROR in m:
+                out["rmse_loss"] = jnp.sum(jnp.sqrt(mse))
+            if MetricsType.MEAN_ABSOLUTE_ERROR in m:
+                out["mae_loss"] = jnp.sum(jnp.abs(diff))
+        return out

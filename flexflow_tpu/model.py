@@ -1,0 +1,538 @@
+"""FFModel — the graph builder and training runtime.
+
+TPU-native analogue of the reference core (reference: src/runtime/model.cc,
+include/model.h:241-434).  The reference FFModel builds an op graph, then
+``compile()`` resolves a per-op ``ParallelConfig`` strategy, creates Legion
+regions/partitions, and the train loop issues index-task launches per op
+with the mapper placing point tasks on GPUs.
+
+Here the same graph compiles to **one fused, jitted SPMD train step**:
+
+  * per-op strategies lower to ``with_sharding_constraint`` annotations on
+    op outputs over a factored device mesh (parallel/mesh.py) — XLA GSPMD
+    inserts all resharding/halo/gradient collectives over ICI, playing the
+    role of Legion's implicit region movement;
+  * the backward pass is ``jax.value_and_grad`` of the scalar loss (no
+    per-op backward methods);
+  * gradient replica aggregation (reference optimizer_kernel.cu:168-180)
+    becomes the automatic psum of sharded-graph gradients;
+  * the reference's Legion-trace replay (begin_trace/end_trace around the
+    hot loop, e.g. examples/cpp/AlexNet/alexnet.cc:110-117) is subsumed by
+    XLA compilation caching — every step after the first replays the same
+    fused program.
+
+The reference's 4-call driver API (``forward/zero_gradients/backward/
+update``) is preserved: the calls stage work and the fused step executes at
+``update()``; ``eval_*`` paths run a forward-only jit.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .config import DeviceType, FFConfig, ParallelConfig
+from .initializers import DefaultWeightInitializer
+from .losses import Loss, LossType
+from .metrics import Metrics, MetricsType, PerfMetrics
+from .ops.base import FwdCtx, Op
+from .ops.conv2d import ActiMode, Conv2D, Pool2D, PoolType
+from .ops.embedding import AggrMode, Embedding
+from .ops.linear import Linear
+from .ops.misc import (BatchNorm, Concat, Dropout, ElementBinary, ElementUnary,
+                       Flat, MSELoss, Softmax)
+from .parallel.mesh import Machine
+from .parallel.strategy import load_strategies_from_file, save_strategies_to_file
+from .tensor import DataType, Parameter, Tensor
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self._guid = itertools.count(100)  # reference op_global_guid starts at 100
+        self.ops: List[Op] = []
+        self.input_tensors: List[Tensor] = []
+        self.label_tensor: Optional[Tensor] = None
+        self.machine: Optional[Machine] = None
+        self.optimizer = None
+        self.loss: Optional[Loss] = None
+        self.metrics: Optional[Metrics] = None
+        self.current_metrics = PerfMetrics()
+        self.last_loss: Optional[float] = None
+        self._metric_acc = None
+        self._params = None
+        self._stats = None
+        self._opt_state = None
+        self._step_count = 0
+        self._batch: Optional[Dict[str, Any]] = None
+        self._staged = False
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._compiled = False
+
+    # ------------------------------------------------------------------
+    # graph construction
+    # ------------------------------------------------------------------
+    def _next_op_guid(self) -> int:
+        return next(self._guid)
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.config.compute_dtype == "bfloat16" else jnp.float32
+
+    def create_tensor(self, dims: Sequence[int], name: str = "",
+                      dtype: str = DataType.FLOAT, nchw: bool = True) -> Tensor:
+        """Create a graph input.  4-D dims are accepted in the reference's
+        (N, C, H, W) order by default (include/model.h create_tensor<4>)
+        and stored NHWC-native; pass ``nchw=False`` for native order."""
+        dims = tuple(int(d) for d in dims)
+        if len(dims) == 4 and nchw:
+            n, c, h, w = dims
+            dims = (n, h, w, c)
+        t = Tensor(dims=dims, dtype=dtype, owner_op=None, name=name)
+        self.input_tensors.append(t)
+        return t
+
+    def _append(self, op: Op) -> Tensor:
+        self.ops.append(op)
+        return op.output
+
+    # -- op vocabulary (reference: include/model.h:241-434) ------------
+    def conv2d(self, input_tensor: Tensor, out_channels: int, kernel_h: int,
+               kernel_w: int, stride_h: int, stride_w: int, padding_h: int,
+               padding_w: int, activation: str = ActiMode.NONE,
+               use_bias: bool = True, groups: int = 1,
+               kernel_initializer=None, bias_initializer=None,
+               name: Optional[str] = None) -> Tensor:
+        return self._append(Conv2D(self, input_tensor, out_channels, kernel_h,
+                                   kernel_w, stride_h, stride_w, padding_h,
+                                   padding_w, activation, use_bias, groups,
+                                   kernel_initializer, bias_initializer, name))
+
+    def pool2d(self, input_tensor: Tensor, kernel_h: int, kernel_w: int,
+               stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+               pool_type: str = PoolType.MAX, activation: str = ActiMode.NONE,
+               name: Optional[str] = None) -> Tensor:
+        return self._append(Pool2D(self, input_tensor, kernel_h, kernel_w,
+                                   stride_h, stride_w, padding_h, padding_w,
+                                   pool_type, activation, name))
+
+    def dense(self, input_tensor: Tensor, out_dim: int,
+              activation: str = ActiMode.NONE, use_bias: bool = True,
+              kernel_initializer=None, bias_initializer=None,
+              name: Optional[str] = None) -> Tensor:
+        return self._append(Linear(self, input_tensor, out_dim, activation,
+                                   use_bias, kernel_initializer,
+                                   bias_initializer, name))
+
+    linear = dense
+
+    def embedding(self, input_tensor: Tensor, num_entries: int, out_dim: int,
+                  aggr: str = AggrMode.SUM, kernel_initializer=None,
+                  name: Optional[str] = None) -> Tensor:
+        return self._append(Embedding(self, input_tensor, num_entries, out_dim,
+                                      aggr, kernel_initializer, name))
+
+    def concat(self, tensors: Sequence[Tensor], axis: int,
+               name: Optional[str] = None) -> Tensor:
+        # Reference axis is in NCHW logical order (concat.cu); convert the
+        # channel axis for 4-D tensors to the native NHWC position.
+        if tensors[0].num_dims == 4:
+            axis = {0: 0, 1: 3, 2: 1, 3: 2}[axis]
+        return self._append(Concat(self, tensors, axis, name))
+
+    def flat(self, input_tensor: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._append(Flat(self, input_tensor, name))
+
+    def softmax(self, input_tensor: Tensor, name: Optional[str] = None) -> Tensor:
+        return self._append(Softmax(self, input_tensor, name))
+
+    def batch_norm(self, input_tensor: Tensor, relu: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        return self._append(BatchNorm(self, input_tensor, relu, name))
+
+    def dropout(self, input_tensor: Tensor, rate: float, seed: int = 0,
+                name: Optional[str] = None) -> Tensor:
+        return self._append(Dropout(self, input_tensor, rate, seed, name))
+
+    def mse_loss(self, logits: Tensor, labels: Tensor,
+                 reduction: str = "average", name: Optional[str] = None) -> Tensor:
+        return self._append(MSELoss(self, logits, labels, reduction, name))
+
+    def _unary(self, op_name, x, name=None):
+        return self._append(ElementUnary(self, x, op_name, name))
+
+    def exp(self, x, name=None):
+        return self._unary("exp", x, name)
+
+    def relu(self, x, name=None):
+        return self._unary("relu", x, name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary("sigmoid", x, name)
+
+    def tanh(self, x, name=None):
+        return self._unary("tanh", x, name)
+
+    def elu(self, x, name=None):
+        return self._unary("elu", x, name)
+
+    def _binary(self, op_name, x, y, name=None):
+        return self._append(ElementBinary(self, x, y, op_name, name))
+
+    def add(self, x, y, name=None):
+        return self._binary("add", x, y, name)
+
+    def subtract(self, x, y, name=None):
+        return self._binary("subtract", x, y, name)
+
+    def multiply(self, x, y, name=None):
+        return self._binary("multiply", x, y, name)
+
+    def divide(self, x, y, name=None):
+        return self._binary("divide", x, y, name)
+
+    # ------------------------------------------------------------------
+    # compile
+    # ------------------------------------------------------------------
+    def compile(self, optimizer=None, loss_type: str = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics: Sequence[str] = (MetricsType.ACCURACY,),
+                machine: Optional[Machine] = None) -> None:
+        """Resolve strategies, build the mesh, stage the jitted SPMD step.
+
+        Mirrors FFModel::compile (src/runtime/model.cc:986-1046): optional
+        strategy import / search, per-op partition resolution, label tensor
+        creation, optimizer wiring.
+        """
+        cfg = self.config
+        self.optimizer = optimizer
+        self.loss = Loss(loss_type)
+        self.metrics = Metrics(self.loss.loss_type, list(metrics))
+        self.machine = machine or Machine(num_devices=min(
+            cfg.num_devices, len(jax.devices())))
+
+        if cfg.import_strategy_file:
+            cfg.strategies.update(load_strategies_from_file(
+                cfg.import_strategy_file,
+                reference_order=cfg.import_strategy_reference_order))
+        if cfg.search_budget > 0:
+            from .simulator.search import mcmc_search
+
+            best = mcmc_search(self, budget=cfg.search_budget, alpha=cfg.search_alpha)
+            cfg.strategies.update(best)
+
+        # Per-op partition configs (default: data parallel over all devices,
+        # reference model.cc:391-401 + strategy.cc:28-85 fallback).
+        nd = self.machine.num_devices
+        for op in self.ops:
+            pc = cfg.find_parallel_config(op.output.num_dims, op.name)
+            if pc.num_parts() > nd:
+                pc = ParallelConfig.data_parallel(op.output.num_dims, nd)
+            op.pc = pc
+
+        # Export AFTER resolution so imported/searched configs are what get
+        # written (reference exports from FFConfig::strategies the same way).
+        if cfg.export_strategy_file:
+            save_strategies_to_file(cfg.export_strategy_file, self._all_strategies())
+
+        # Label tensor (reference creates it in compile; dims follow loss).
+        logits = self._loss_input_tensor()
+        if self.loss.loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            self.label_tensor = Tensor((logits.dims[0], 1), DataType.INT32, name="label")
+        else:
+            self.label_tensor = Tensor(tuple(self.final_tensor().dims), DataType.FLOAT, name="label")
+
+        self._compiled = True
+        self._train_step_fn = None
+        self._eval_step_fn = None
+
+    def _all_strategies(self) -> Dict[str, ParallelConfig]:
+        return {op.name: getattr(op, "pc", ParallelConfig.data_parallel(
+            op.output.num_dims, self.machine.num_devices)) for op in self.ops}
+
+    def final_tensor(self) -> Tensor:
+        return self.ops[-1].output
+
+    def _loss_input_tensor(self) -> Tensor:
+        """Pre-softmax activations when the loss fuses with a trailing
+        Softmax (the stable log-softmax+CE path — see losses.py)."""
+        last = self.ops[-1]
+        if isinstance(last, Softmax) and self.loss is not None and self.loss.wants_logits:
+            return last.inputs[0]
+        return last.output
+
+    # ------------------------------------------------------------------
+    # parameter/state initialization (≈ FFModel::init_layers + initializer
+    # tasks, src/runtime/initializer.cc)
+    # ------------------------------------------------------------------
+    def _param_spec_tree(self) -> Dict[str, Dict[str, NamedSharding]]:
+        out: Dict[str, Dict[str, NamedSharding]] = {}
+        for op in self.ops:
+            if not op.weights:
+                continue
+            degrees = list(op.pc.dims)
+            rank = op.output.num_dims
+            degrees += [1] * (rank - len(degrees))
+            groups = self.machine.axes_for_degrees(degrees[:rank])
+            specs = {}
+            for w in op.weights:
+                entries = []
+                for pd in w.partition_dims:
+                    if pd is None or pd >= len(groups) or not groups[pd]:
+                        entries.append(None)
+                    else:
+                        g = groups[pd]
+                        entries.append(g if len(g) > 1 else g[0])
+                while entries and entries[-1] is None:
+                    entries.pop()
+                specs[w.name] = NamedSharding(self.machine.mesh, PartitionSpec(*entries))
+            out[op.name] = specs
+        return out
+
+    def init_layers(self, seed: Optional[int] = None) -> None:
+        assert self._compiled, "call compile() first"
+        seed = self.config.seed if seed is None else seed
+        key = jax.random.key(seed)
+        shardings = self._param_spec_tree()
+
+        ops_with_weights = [op for op in self.ops if op.weights]
+
+        import zlib
+
+        def init_fn(key):
+            params = {}
+            for op in ops_with_weights:
+                p = {}
+                for w in op.weights:
+                    # Deterministic per-(op, weight) stream: same graph →
+                    # same init regardless of strategy or process history.
+                    salt = zlib.crc32(f"{op.name}/{w.name}".encode())
+                    p[w.name] = w.initializer(jax.random.fold_in(key, salt),
+                                              w.dims, jnp.float32)
+                params[op.name] = p
+            return params
+
+        self._params = jax.jit(init_fn, out_shardings=shardings)(key)
+        self._stats = {}
+        for op in self.ops:
+            st = op.init_stats()
+            if st:
+                self._stats[op.name] = jax.device_put(
+                    st, self.machine.replicated())
+        # Optimizer state mirrors the params pytree and inherits each
+        # param's sharding (momentum/moment buffers live with their shard).
+        self._opt_state = (self.optimizer.init_state(self._params)
+                           if self.optimizer is not None else None)
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    # forward-graph evaluation (inside jit)
+    # ------------------------------------------------------------------
+    def _run_graph(self, params, stats, batch, training: bool, rng):
+        env: Dict[int, jax.Array] = {}
+        multi = self.machine.num_devices > 1
+        cdtype = self.compute_dtype
+        for t in self.input_tensors:
+            x = batch[f"in_{t.guid}"]
+            if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdtype:
+                # Activations run in compute_dtype (bfloat16 on the MXU for
+                # benchmarks); params stay float32 and ops cast per-use.
+                x = x.astype(cdtype)
+            if multi:
+                deg = self._input_batch_degree(t)
+                if deg > 1:
+                    x = jax.lax.with_sharding_constraint(
+                        x, self.machine.batch_sharding(deg))
+            env[t.guid] = x
+        ctx = FwdCtx(training=training, rng=rng, stats_in=stats,
+                     stats_out={} if training else None)
+        for op in self.ops:
+            xs = [env[t.guid] for t in op.inputs]
+            pvals = params.get(op.name, {})
+            ys = op.forward(pvals, xs, ctx)
+            if multi:
+                ys = [self.machine.constraint(y, op.pc) for y in ys]
+            for t, y in zip(op.outputs, ys):
+                env[t.guid] = y
+        new_stats = dict(stats)
+        if training and ctx.stats_out:
+            new_stats.update(ctx.stats_out)
+        return env, new_stats
+
+    def _input_batch_degree(self, t: Tensor) -> int:
+        for op in self.ops:
+            if t in op.inputs:
+                return op.pc.dims[0]
+        return 1
+
+    # ------------------------------------------------------------------
+    # the fused SPMD train step
+    # ------------------------------------------------------------------
+    def _build_train_step(self):
+        loss_t = self._loss_input_tensor()
+        probs_t = self.final_tensor()
+        base_key = jax.random.key(self.config.seed + 7919)
+        opt = self.optimizer
+        metrics = self.metrics
+        loss_fn_obj = self.loss
+
+        mkeys = self._metric_keys()
+
+        def step(params, stats, opt_state, hparams, batch, step_idx, macc):
+            rng = jax.random.fold_in(base_key, step_idx)
+            labels = batch["label"]
+
+            def loss_fn(p):
+                env, new_stats = self._run_graph(p, stats, batch, True, rng)
+                loss = loss_fn_obj(env[loss_t.guid], labels)
+                return loss, (env[probs_t.guid], new_stats)
+
+            (loss, (probs, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            msum = metrics.compute(probs, labels)
+            msum["loss"] = loss
+            # On-device metric accumulation: one small vector rides along
+            # and is fetched once per drain — the analogue of the
+            # reference's future-chain metric fold (model.cc:1145-1167)
+            # without a host round-trip per step.
+            mvec = jnp.stack([jnp.float32(msum.get(k, 0.0)) for k in mkeys])
+            new_params, new_opt = opt.apply(params, grads, opt_state, hparams)
+            return new_params, new_stats, new_opt, macc + mvec
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 6))
+
+    def _build_eval_step(self):
+        loss_t = self._loss_input_tensor()
+        probs_t = self.final_tensor()
+        metrics = self.metrics
+        loss_fn_obj = self.loss
+
+        def estep(params, stats, batch):
+            env, _ = self._run_graph(params, stats, batch, False, None)
+            labels = batch["label"]
+            loss = loss_fn_obj(env[loss_t.guid], labels)
+            msum = metrics.compute(env[probs_t.guid], labels)
+            msum["loss"] = loss
+            return msum, env[probs_t.guid]
+
+        return jax.jit(estep)
+
+    # ------------------------------------------------------------------
+    # driver API (reference: forward/zero_gradients/backward/update —
+    # staged here, fused execution at update())
+    # ------------------------------------------------------------------
+    def set_batch(self, inputs: Dict[Tensor, Any], labels: Any) -> None:
+        batch: Dict[str, Any] = {}
+        for t, arr in inputs.items():
+            batch[f"in_{t.guid}"] = self._place_batch(arr, self._input_batch_degree(t))
+        deg = getattr(self.ops[-1], "pc", ParallelConfig(dims=(1,))).dims[0] \
+            if self.ops else 1
+        batch["label"] = self._place_batch(labels, deg)
+        self._batch = batch
+
+    def _place_batch(self, arr, degree: int):
+        if isinstance(arr, jax.Array) and arr.committed:
+            return arr
+        arr = np.asarray(arr)
+        return jax.device_put(arr, self.machine.batch_sharding(degree))
+
+    def forward(self) -> None:
+        self._staged = True
+
+    def zero_gradients(self) -> None:
+        """No-op: gradients are functional values, freshly computed per
+        step (the reference zeroes its accumulation regions,
+        model.cc:1109-1132)."""
+
+    def backward(self) -> None:
+        self._staged = True
+
+    def _metric_keys(self) -> List[str]:
+        return ["train_all", "train_correct", "cce_loss", "sparse_cce_loss",
+                "mse_loss", "rmse_loss", "mae_loss", "loss"]
+
+    def update(self) -> None:
+        assert self._batch is not None, "no batch loaded: call a DataLoader first"
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        if self._opt_state is None:
+            self._opt_state = self.optimizer.init_state(self._params)
+        if self._metric_acc is None:
+            self._metric_acc = jnp.zeros((len(self._metric_keys()),), jnp.float32)
+        hp = self.optimizer.hparams()
+        self._params, self._stats, self._opt_state, self._metric_acc = \
+            self._train_step_fn(self._params, self._stats, self._opt_state,
+                                hp, self._batch, jnp.uint32(self._step_count),
+                                self._metric_acc)
+        self._step_count += 1
+        self._staged = False
+
+    def train_iteration(self) -> None:
+        """Convenience: forward+backward+update in one fused call."""
+        self.forward()
+        self.zero_gradients()
+        self.backward()
+        self.update()
+
+    def eval_batch(self) -> Dict[str, float]:
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        msum, _ = self._eval_step_fn(self._params, self._stats, self._batch)
+        return {k: float(v) for k, v in msum.items()}
+
+    # ------------------------------------------------------------------
+    # metrics (reference: UPDATE_METRICS_TASK fold, model.cc:1145-1167)
+    # ------------------------------------------------------------------
+    def reset_metrics(self) -> None:
+        self.current_metrics.reset()
+        self.last_loss = None
+        self._metric_acc = None
+
+    def _drain_metrics(self) -> None:
+        if self._metric_acc is not None:
+            vec = jax.device_get(self._metric_acc)  # single small transfer
+            totals = dict(zip(self._metric_keys(), [float(v) for v in vec]))
+            self.last_loss = totals.pop("loss", None)
+            self.current_metrics.update(totals)
+            self._metric_acc = jnp.zeros_like(self._metric_acc)
+
+    def get_metrics(self) -> PerfMetrics:
+        self._drain_metrics()
+        return self.current_metrics
+
+    def print_metrics(self) -> None:
+        self.get_metrics().print()
+
+    def sync(self) -> None:
+        """Block until all dispatched device work completes (the analogue
+        of the reference's execution fence + timing future).  Forces a
+        small device→host transfer: a real synchronization barrier on
+        every backend (block_until_ready alone does not block on some
+        experimental PJRT platforms)."""
+        if self._metric_acc is not None:
+            jax.device_get(self._metric_acc)
+        elif self._params is not None:
+            leaf = jax.tree.leaves(self._params)[0]
+            jax.device_get(jnp.sum(leaf))
+
+    # ------------------------------------------------------------------
+    # weight access (reference: Parameter::set_weights/get_weights,
+    # src/runtime/model.cu:260-370)
+    # ------------------------------------------------------------------
+    def get_parameter(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        return np.asarray(self._params[op_name][weight_name])
+
+    def set_parameter(self, op_name: str, weight_name: str, value: np.ndarray) -> None:
+        cur = self._params[op_name][weight_name]
+        self._params[op_name][weight_name] = jax.device_put(
+            jnp.asarray(value, dtype=cur.dtype), cur.sharding)
+
+    def get_strategies(self) -> Dict[str, ParallelConfig]:
+        return self._all_strategies()

@@ -1,0 +1,8 @@
+"""Operator library (TPU-native analogues of src/ops/*.cu)."""
+
+from .base import FwdCtx, Op
+from .conv2d import ActiMode, Conv2D, Pool2D, PoolType, apply_activation
+from .embedding import AggrMode, Embedding
+from .linear import Linear
+from .misc import (BatchNorm, Concat, Dropout, ElementBinary, ElementUnary,
+                   Flat, MSELoss, Softmax)

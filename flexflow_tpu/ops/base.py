@@ -1,0 +1,94 @@
+"""Operator base class.
+
+TPU-native analogue of the reference ``Op`` abstract class
+(reference: include/model.h:190-231).  The reference contract is 8 Legion
+methods (create_output_and_partition / create_weights / init / forward /
+backward / measure_compute_time ...); here an op is a *pure function* plus
+shape/partition metadata:
+
+  * construction performs shape inference and declares weights
+    (≈ create_weights + create_output_and_partition),
+  * ``forward`` is a jit-traceable function of (weights, inputs) — the
+    backward pass comes from ``jax.grad``, so no hand-written backward,
+  * ``weight_partition_dims`` maps each weight dim to the output-config
+    dim it shards with (compile lowers this to NamedShardings — the
+    analogue of create_weights' region partitioning),
+  * the simulator costs ops by compiling+timing ``forward`` on sub-shapes
+    (≈ measure_compute_time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from ..tensor import Parameter, Tensor
+
+
+@dataclasses.dataclass
+class FwdCtx:
+    """Per-call context threaded through op forwards inside jit."""
+
+    training: bool = False
+    rng: Optional[jax.Array] = None  # folded per-op by guid before use
+    stats_in: Optional[Dict[str, Dict[str, jax.Array]]] = None
+    stats_out: Optional[Dict[str, Dict[str, jax.Array]]] = None
+
+    def op_rng(self, op: "Op") -> jax.Array:
+        assert self.rng is not None, "op requires an RNG but none was provided"
+        return jax.random.fold_in(self.rng, op.guid)
+
+
+class Op:
+    """Graph node: inputs → outputs with optional weights/state."""
+
+    _type: str = "Op"
+
+    def __init__(self, model, inputs: Sequence[Tensor], name: Optional[str] = None):
+        self.model = model
+        self.guid = model._next_op_guid()
+        # Reference auto-names ops "<Type>_<guid>" (src/runtime/model.cc:142-144)
+        # unless the _v2 named API supplies one; strategy files bind by name.
+        self.name = name if name else f"{self._type}_{self.guid}"
+        self.inputs: List[Tensor] = list(inputs)
+        self.weights: List[Parameter] = []
+        self.outputs: List[Tensor] = []
+        self.profiling = False
+
+    # -- graph construction ------------------------------------------------
+    def _add_output(self, dims, dtype="float32") -> Tensor:
+        t = Tensor(dims=tuple(dims), dtype=dtype, owner_op=self, owner_idx=len(self.outputs))
+        self.outputs.append(t)
+        return t
+
+    def _add_weight(self, name, dims, initializer, partition_dims=None, dtype="float32") -> Parameter:
+        p = Parameter(name=name, dims=tuple(dims), dtype=dtype,
+                      initializer=initializer, owner_op=self,
+                      partition_dims=partition_dims)
+        self.weights.append(p)
+        return p
+
+    @property
+    def output(self) -> Tensor:
+        return self.outputs[0]
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, params: Dict[str, jax.Array], xs: List[jax.Array], ctx: FwdCtx) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # -- stats (non-trainable state, e.g. batchnorm running moments) -------
+    def init_stats(self) -> Dict[str, jax.Array]:
+        return {}
+
+    # -- cost model hooks (used by the simulator) --------------------------
+    def flops_per_sample(self) -> float:
+        """Analytic forward FLOPs per sample; simulator fallback when a
+        measured timing is unavailable."""
+        return 0.0
+
+    def __repr__(self):
+        ins = ",".join(str(t.dims) for t in self.inputs)
+        outs = ",".join(str(t.dims) for t in self.outputs)
+        return f"{self._type}({self.name}: {ins} -> {outs})"

@@ -1,0 +1,156 @@
+"""Conv2D / Pool2D operators (NHWC, MXU-native).
+
+Reference: src/ops/conv_2d.cu (1040 LoC of cuDNN host/launcher code) and
+src/ops/pool_2d.cu.  Shape formula matches conv_2d.cu:100-101:
+``out = 1 + (in + 2*pad - kernel) / stride``.
+
+TPU-native design notes:
+  * activations are NHWC so channels ride the 128-lane dim; kernels are
+    HWIO — the layouts XLA:TPU tiles directly onto the MXU without
+    relayout.
+  * convolution lowers to a single ``lax.conv_general_dilated``; bias and
+    activation fuse into it at the XLA level (no separate kernels as in
+    the cuDNN path).
+  * float32 accumulation is requested via ``preferred_element_type`` when
+    activations are bfloat16.
+  * spatial (H/W) partitioning — the reference's "attribute" parallelism
+    with implicit Legion halo copies (conv_2d.cu:173-211) — is expressed by
+    sharding H/W mesh axes; XLA GSPMD emits the halo-exchange
+    collective-permutes over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import FwdCtx, Op
+from ..initializers import DefaultBiasInitializer, DefaultWeightInitializer
+
+
+class ActiMode:
+    NONE = "none"
+    RELU = "relu"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+
+
+def apply_activation(x, activation: Optional[str]):
+    if not activation or activation == ActiMode.NONE:
+        return x
+    if activation == ActiMode.RELU:
+        return jax.nn.relu(x)
+    if activation == ActiMode.SIGMOID:
+        return jax.nn.sigmoid(x)
+    if activation == ActiMode.TANH:
+        return jnp.tanh(x)
+    raise ValueError(f"unknown activation {activation}")
+
+
+class Conv2D(Op):
+    _type = "Conv2D"
+
+    def __init__(self, model, input_tensor, out_channels: int,
+                 kernel_h: int, kernel_w: int, stride_h: int, stride_w: int,
+                 padding_h: int, padding_w: int, activation: str = ActiMode.NONE,
+                 use_bias: bool = True, groups: int = 1,
+                 kernel_initializer=None, bias_initializer=None,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        n, h, w, cin = input_tensor.dims
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.groups = groups
+        out_h = 1 + (h + 2 * padding_h - kernel_h) // stride_h
+        out_w = 1 + (w + 2 * padding_w - kernel_w) // stride_w
+        self._add_output((n, out_h, out_w, out_channels), input_tensor.dtype)
+        # Kernel replicated across sample/spatial parts (the reference
+        # replicates it and aggregates grad replicas, model.cc:763-787;
+        # here GSPMD psums the gradient); out-channel dim shards with the
+        # output channel config dim (index 3, NHWC).
+        self._add_weight(
+            "kernel", (kernel_h, kernel_w, cin // groups, out_channels),
+            kernel_initializer or DefaultWeightInitializer(),
+            partition_dims=(None, None, None, 3))
+        if use_bias:
+            self._add_weight("bias", (out_channels,),
+                             bias_initializer or DefaultBiasInitializer(),
+                             partition_dims=(3,))
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        x = xs[0]
+        kernel = params["kernel"].astype(x.dtype)
+        ph, pw = self.padding
+        y = lax.conv_general_dilated(
+            x, kernel,
+            window_strides=self.stride,
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        ).astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return [apply_activation(y, self.activation)]
+
+    def flops_per_sample(self):
+        _, oh, ow, oc = self.output.dims
+        kh, kw = self.kernel
+        cin = self.inputs[0].dims[3]
+        return 2.0 * oh * ow * oc * kh * kw * (cin // self.groups)
+
+
+class PoolType:
+    MAX = "max"
+    AVG = "avg"
+
+
+class Pool2D(Op):
+    _type = "Pool2D"
+
+    def __init__(self, model, input_tensor, kernel_h: int, kernel_w: int,
+                 stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+                 pool_type: str = PoolType.MAX, activation: str = ActiMode.NONE,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        n, h, w, c = input_tensor.dims
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.pool_type = pool_type
+        self.activation = activation
+        out_h = 1 + (h + 2 * padding_h - kernel_h) // stride_h
+        out_w = 1 + (w + 2 * padding_w - kernel_w) // stride_w
+        self._add_output((n, out_h, out_w, c), input_tensor.dtype)
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        x = xs[0]
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.padding
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        if self.pool_type == PoolType.MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init, lax.max, dims, strides, pads)
+        else:
+            # Average with padding excluded from the divisor, matching
+            # cuDNN's CUDNN_POOLING_AVERAGE_COUNT_EXCLUDE_PADDING used by
+            # the reference pool op.
+            s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, dims, strides, pads)
+            ones = jnp.ones(x.shape[1:3], jnp.float32)[None, :, :, None]
+            cnt = lax.reduce_window(ones, 0.0, lax.add, (1, kh, kw, 1), strides,
+                                    ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+            y = (s / cnt).astype(x.dtype)
+        return [apply_activation(y, self.activation)]
+
+    def flops_per_sample(self):
+        _, oh, ow, c = self.output.dims
+        return float(oh * ow * c * self.kernel[0] * self.kernel[1])

@@ -1,0 +1,72 @@
+"""Embedding operator.
+
+Reference: src/ops/embedding.cu (custom gather/scatter kernels, SUM/AVG
+aggregation, embedding.cu:173-220) + CPU task variants (embedding.cc:18-77)
+that let DLRM keep huge tables in host zero-copy memory.
+
+TPU-native: a ``jnp.take`` gather — XLA lowers it to a dynamic-gather that
+runs on-chip; the backward scatter-add comes from autodiff.  Large tables
+shard their *embedding dim* along the output channel config dim (riding
+ICI), and the reference's CPU placement maps to host-offload: a config
+with ``device_type=CPU`` pins the table to host memory via
+``jax.device_put`` with a host-memory-kind sharding (DLRM path).
+
+Input is (B, num_indices) int32; aggregation SUM or AVG over the
+``num_indices`` dim, exactly the reference semantics.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import FwdCtx, Op
+from ..initializers import GlorotUniform
+
+
+class AggrMode:
+    NONE = "none"
+    SUM = "sum"
+    AVG = "avg"
+
+
+class Embedding(Op):
+    _type = "Embedding"
+
+    def __init__(self, model, input_tensor, num_entries: int, out_dim: int,
+                 aggr: str = AggrMode.SUM, kernel_initializer=None,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        self.num_entries = num_entries
+        self.out_dim = out_dim
+        self.aggr = aggr
+        batch = input_tensor.dims[0]
+        if aggr == AggrMode.NONE:
+            if len(input_tensor.dims) != 2 or input_tensor.dims[1] != 1:
+                # keep the sequence dim
+                self._add_output(input_tensor.dims + (out_dim,), "float32")
+            else:
+                self._add_output((batch, out_dim), "float32")
+        else:
+            self._add_output((batch, out_dim), "float32")
+        self._add_weight("weight", (num_entries, out_dim),
+                         kernel_initializer or GlorotUniform(),
+                         partition_dims=(None, len(self.output.dims) - 1))
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        idx = xs[0].astype(jnp.int32)
+        table = params["weight"]
+        emb = jnp.take(table, idx, axis=0)  # (B, I, D) or (B, D) when idx is (B,)
+        if self.aggr == AggrMode.SUM and emb.ndim == 3:
+            emb = jnp.sum(emb, axis=1)
+        elif self.aggr == AggrMode.AVG and emb.ndim == 3:
+            emb = jnp.mean(emb, axis=1)
+        elif self.aggr == AggrMode.NONE and emb.ndim == 3 and self.output.num_dims == 2:
+            emb = emb[:, 0, :]
+        return [emb.astype(self.model.compute_dtype)]
+
+    def flops_per_sample(self):
+        n_idx = self.inputs[0].dims[1] if len(self.inputs[0].dims) > 1 else 1
+        return float(n_idx * self.out_dim)

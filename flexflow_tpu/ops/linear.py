@@ -1,0 +1,64 @@
+"""Linear (dense) operator — the tensor-parallel workhorse.
+
+Reference: src/ops/linear.cu (864 LoC: 3 cuBLAS GEMMs + replica tensors).
+The reference implements tensor parallelism by replicating the input per
+out-channel shard and summing input-gradient replicas with a dedicated
+``backward2`` launch (linear.cu:594-621,683-703; create_linear_replica
+model.cc:791-846).
+
+TPU-native: one ``jnp.dot`` with the weight sharded on its out-channel dim
+along the same mesh axes as the output's channel dim.  XLA GSPMD derives
+the forward all-gather/identity and the backward ``psum`` of the input
+gradient automatically — the entire replica machinery reduces to a
+sharding annotation.  MXU accumulation in float32 via
+``preferred_element_type`` for bf16 activations.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import FwdCtx, Op
+from .conv2d import ActiMode, apply_activation
+from ..initializers import DefaultBiasInitializer, DefaultWeightInitializer
+
+
+class Linear(Op):
+    _type = "Dense"
+
+    def __init__(self, model, input_tensor, out_dim: int,
+                 activation: str = ActiMode.NONE, use_bias: bool = True,
+                 kernel_initializer=None, bias_initializer=None,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        in_dim = input_tensor.dims[-1]
+        lead = input_tensor.dims[:-1]
+        self.activation = activation
+        self.use_bias = use_bias
+        self._add_output(lead + (out_dim,), input_tensor.dtype)
+        out_cfg_dim = len(lead + (out_dim,)) - 1  # channel dim of the output
+        self._add_weight("kernel", (in_dim, out_dim),
+                         kernel_initializer or DefaultWeightInitializer(),
+                         partition_dims=(None, out_cfg_dim))
+        if use_bias:
+            self._add_weight("bias", (out_dim,),
+                             bias_initializer or DefaultBiasInitializer(),
+                             partition_dims=(out_cfg_dim,))
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        x = xs[0]
+        kernel = params["kernel"].astype(x.dtype)
+        y = jnp.dot(x, kernel,
+                    preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        y = y.astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return [apply_activation(y, self.activation)]
+
+    def flops_per_sample(self):
+        in_dim = self.inputs[0].dims[-1]
+        out_dim = self.output.dims[-1]
+        return 2.0 * in_dim * out_dim

@@ -1,0 +1,142 @@
+"""Optimizers with reference-exact update semantics.
+
+TPU-native analogue of the reference optimizer layer
+(reference: src/runtime/optimizer.cc, src/runtime/optimizer_kernel.cu,
+include/optimizer.h).  The reference runs one Legion task per parameter
+which (a) sums the ``num_replicas`` stacked gradient copies and (b) applies
+the update on the parameter's home GPU.  Here step (a) is subsumed by
+GSPMD: gradients of replicated/sharded params come out of ``jax.grad``
+already summed across the mesh (XLA inserts the ``psum``/reduce-scatter
+collectives over ICI), so only the update math remains — implemented as
+pure functions over the parameter pytree, jitted and sharded with it.
+
+Time-varying scalars (lr, Adam's alpha_t) are threaded as traced arguments
+so epoch advancement never retriggers XLA compilation.
+
+Update formulas match the reference kernels exactly:
+  * SGD  (optimizer_kernel.cu:23-40, pytorch-style):
+        gt = g + wd*w
+        if momentum: v = momentum*v + gt; gt = nesterov ? gt + momentum*v : v
+        w -= lr * gt
+  * Adam (optimizer_kernel.cu:206-225 + alpha_t schedule in
+    AdamOptimizer::next_epoch, src/runtime/optimizer.cc):
+        gt = g + wd*w
+        m = b1*m + (1-b1)*gt ; v = b2*v + (1-b2)*gt^2
+        w -= alpha_t * m / (sqrt(v) + eps),
+        alpha_t = alpha * sqrt(1-b2^t) / (1-b1^t)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+OptState = Dict[str, Any]
+HParams = Dict[str, Any]
+
+
+class Optimizer:
+    """Base optimizer. State is a pytree mirroring the params pytree."""
+
+    def init_state(self, params: Params) -> OptState:
+        raise NotImplementedError
+
+    def hparams(self) -> HParams:
+        """Current dynamic scalars, passed into the jitted step each call."""
+        raise NotImplementedError
+
+    def apply(self, params: Params, grads: Params, state: OptState,
+              hparams: HParams) -> Tuple[Params, OptState]:
+        raise NotImplementedError
+
+    def next_epoch(self) -> None:
+        """Per-epoch hook (reference Optimizer::next_epoch): Adam advances
+        its bias-correction schedule here; SGD has no epoch state."""
+
+
+def _unzip(tree, n):
+    is_tup = lambda t: isinstance(t, tuple)
+    return tuple(jax.tree.map(lambda t, i=i: t[i], tree, is_leaf=is_tup) for i in range(n))
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, model=None, lr: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False, weight_decay: float = 0.0):
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.nesterov = bool(nesterov)
+        self.weight_decay = float(weight_decay)
+
+    def init_state(self, params):
+        if self.momentum > 0.0:
+            return {"v": jax.tree.map(jnp.zeros_like, params)}
+        return {}
+
+    def hparams(self):
+        return {"lr": jnp.float32(self.lr)}
+
+    def apply(self, params, grads, state, hparams):
+        lr = hparams["lr"]
+        wd, mom = self.weight_decay, self.momentum
+
+        if mom > 0.0:
+            def upd(w, g, v):
+                gt = g + wd * w
+                v = v * mom + gt
+                step = gt + mom * v if self.nesterov else v
+                return w - lr * step.astype(w.dtype), v
+
+            out = jax.tree.map(upd, params, grads, state["v"])
+            new_params, new_v = _unzip(out, 2)
+            return new_params, {"v": new_v}
+
+        def upd_plain(w, g):
+            return w - lr * (g + wd * w).astype(w.dtype)
+
+        return jax.tree.map(upd_plain, params, grads), {}
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, model=None, alpha: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, weight_decay: float = 0.0, epsilon: float = 1e-8):
+        self.alpha = float(alpha)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.weight_decay = float(weight_decay)
+        self.epsilon = float(epsilon)
+        # Bias-correction schedule mirroring the reference's
+        # alpha_t/beta1_t/beta2_t fields (include/optimizer.h).
+        self.beta1_t = 1.0
+        self.beta2_t = 1.0
+        self.alpha_t = self.alpha
+
+    def next_epoch(self):
+        self.beta1_t *= self.beta1
+        self.beta2_t *= self.beta2
+        self.alpha_t = self.alpha * (1.0 - self.beta2_t) ** 0.5 / (1.0 - self.beta1_t)
+
+    def init_state(self, params):
+        return {
+            "m": jax.tree.map(jnp.zeros_like, params),
+            "v": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def hparams(self):
+        return {"alpha_t": jnp.float32(self.alpha_t)}
+
+    def apply(self, params, grads, state, hparams):
+        alpha_t = hparams["alpha_t"]
+        wd, b1, b2, eps = self.weight_decay, self.beta1, self.beta2, self.epsilon
+
+        def upd(w, g, m, v):
+            gt = (g + wd * w).astype(jnp.float32)
+            mt = b1 * m + (1.0 - b1) * gt
+            vt = b2 * v + (1.0 - b2) * gt * gt
+            return (w - alpha_t * mt / (jnp.sqrt(vt) + eps)).astype(w.dtype), mt, vt
+
+        out = jax.tree.map(upd, params, grads, state["m"], state["v"])
+        new_params, new_m, new_v = _unzip(out, 3)
+        return new_params, {"m": new_m, "v": new_v}

@@ -1,0 +1,148 @@
+"""Device-mesh abstraction: SOAP partition configs → JAX shardings.
+
+This is the TPU-native replacement for the reference's mapper + Legion
+partition machinery (reference: src/mapper/mapper.cc:33-146,
+src/runtime/model.cc:466-606).  The reference creates a Legion index task
+space per op shaped like the op's ``ParallelConfig`` and maps each point
+task to the GPU in ``device_ids``; Legion inserts the data movement when
+consecutive ops use different partitions.
+
+On TPU, the same SOAP space is expressed through one global
+``jax.sharding.Mesh`` whose axes are the *prime factors* of the device
+count.  Any per-dim partition degree that divides the device count then
+lowers to a ``PartitionSpec`` assigning a subset of mesh axes to that
+tensor dim; XLA GSPMD inserts the resharding collectives (over ICI) when
+producer and consumer specs differ — the analogue of Legion's implicit
+region copies.
+
+Example: 8 devices → mesh axes ('m0','m1','m2'), each size 2.  A Conv2D
+config with dims (4, 1, 2, 1) [N,H,W,C] lowers to
+PartitionSpec(('m0','m1'), None, ('m2',), None); a following Dense with
+dims (8, 1) lowers to PartitionSpec(('m0','m1','m2'), None) — GSPMD emits
+the all-to-all between them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import ParallelConfig
+
+
+def _prime_factors(n: int) -> List[int]:
+    out: List[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
+class Machine:
+    """The machine model: an N-device mesh with prime-factored axes.
+
+    ``devices`` defaults to ``jax.devices()``.  For multi-host runs the
+    caller passes the global device list (after ``jax.distributed``
+    initialization); axis order puts larger factors first so that batch-dim
+    sharding lands on the widest axis groups.
+    """
+
+    def __init__(self, devices: Optional[Sequence] = None, num_devices: Optional[int] = None):
+        if devices is None:
+            devices = jax.devices()
+            if num_devices is not None:
+                devices = devices[:num_devices]
+        self.devices = list(devices)
+        n = len(self.devices)
+        factors = _prime_factors(n) if n > 1 else [1]
+        self.axis_sizes: Tuple[int, ...] = tuple(factors)
+        self.axis_names: Tuple[str, ...] = tuple(f"m{i}" for i in range(len(factors)))
+        dev_array = np.array(self.devices).reshape(self.axis_sizes)
+        self.mesh = Mesh(dev_array, self.axis_names)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    # -- spec lowering -----------------------------------------------------
+    def axes_for_degrees(self, degrees: Sequence[int]) -> List[Tuple[str, ...]]:
+        """Assign disjoint mesh-axis groups whose sizes multiply to each
+        requested degree.  Greedy over the factored axes; raises if a degree
+        cannot be composed from the remaining axes (e.g. degree 3 on an
+        8-device mesh)."""
+        remaining = list(zip(self.axis_names, self.axis_sizes))
+        result: List[Tuple[str, ...]] = []
+        for deg in degrees:
+            group: List[str] = []
+            need = deg
+            for i in range(len(remaining)):
+                name, size = remaining[i]
+                if name is None:
+                    continue
+                if need % size == 0:
+                    group.append(name)
+                    need //= size
+                    remaining[i] = (None, 0)
+                    if need == 1:
+                        break
+            if need != 1:
+                raise ValueError(
+                    f"partition degree {deg} not expressible over mesh axes "
+                    f"{dict(zip(self.axis_names, self.axis_sizes))} (degrees={list(degrees)})")
+            result.append(tuple(group))
+        return result
+
+    def spec_for_config(self, pc: ParallelConfig, rank: Optional[int] = None) -> PartitionSpec:
+        """Lower a ParallelConfig to a PartitionSpec over this mesh.
+
+        ``pc.dims[i]`` is the partition degree of tensor dim i (natural
+        order, batch first).  ``rank`` pads/truncates to the actual array
+        rank (e.g. a (B,1) label tensor under a 2-D config)."""
+        degrees = list(pc.dims)
+        if rank is not None:
+            if len(degrees) < rank:
+                degrees = degrees + [1] * (rank - len(degrees))
+            degrees = degrees[:rank]
+        groups = self.axes_for_degrees(degrees)
+        entries = [g if len(g) > 1 else (g[0] if g else None) for g in groups]
+        # PartitionSpec wants None for unsharded dims
+        entries = [e if e else None for e in entries]
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def sharding_for_config(self, pc: ParallelConfig, rank: Optional[int] = None) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec_for_config(pc, rank))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def batch_sharding(self, degree: int) -> NamedSharding:
+        """Sharding for a host-fed batch array: first dim split ``degree``
+        ways, everything else replicated."""
+        if degree <= 1:
+            return self.replicated()
+        axes = self.axes_for_degrees([degree])[0]
+        return NamedSharding(self.mesh,
+                             PartitionSpec(axes if len(axes) > 1 else axes[0]))
+
+    def sharding_for_spec(self, spec: PartitionSpec) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def constraint(self, x, pc: ParallelConfig):
+        """Apply a sharding constraint for an op output inside jit — the
+        analogue of the op's Legion output partition."""
+        spec = self.spec_for_config(pc, rank=x.ndim)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
+
+    def __repr__(self):
+        return f"Machine({dict(zip(self.axis_names, self.axis_sizes))})"

@@ -1,0 +1,152 @@
+"""Strategy-file I/O, wire-compatible with the reference protobuf schema.
+
+Reference: src/runtime/strategy.proto (message ``FFProtoBuf.Strategy`` =
+repeated ``Op{name=1, device_type=2, dims=3, device_ids=4,
+memory_types=5}``) and src/runtime/strategy.cc:87-163 (load/save).
+
+A strategy file maps op names to SOAP ``ParallelConfig``s.  This module
+hand-rolls the proto2 wire format (varints + length-delimited fields) so
+files produced by the reference's ``--export-strategy`` / the DLRM strategy
+generators parse here and vice versa, without a protobuf runtime
+dependency.
+
+Dim-order note: the reference orders config dims in Legion ``adim`` order
+(innermost first, sample last); this framework orders dims naturally
+(batch first, NHWC).  Files exported here carry native order; when loading
+a file produced by the *reference*, pass ``reference_order=True`` (CLI:
+``--import-reference-order``, FFConfig.import_strategy_reference_order) to
+reverse each op's dims on import — the wire format itself cannot indicate
+which convention a file uses.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Tuple
+
+from ..config import DeviceType, ParallelConfig
+
+_WIRE_VARINT = 0
+_WIRE_LEN = 2
+
+
+def _write_varint(buf: io.BytesIO, value: int) -> None:
+    if value < 0:
+        value += 1 << 64  # proto int32 negative → 10-byte varint
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    if result >= (1 << 63):  # re-sign int64 → int
+        result -= 1 << 64
+    return result, pos
+
+
+def _write_tag(buf: io.BytesIO, field: int, wire: int) -> None:
+    _write_varint(buf, (field << 3) | wire)
+
+
+def _encode_op(name: str, pc: ParallelConfig) -> bytes:
+    buf = io.BytesIO()
+    _write_tag(buf, 1, _WIRE_LEN)
+    nb = name.encode("utf-8")
+    _write_varint(buf, len(nb))
+    buf.write(nb)
+    _write_tag(buf, 2, _WIRE_VARINT)
+    _write_varint(buf, pc.device_type.value)
+    for d in pc.dims:
+        _write_tag(buf, 3, _WIRE_VARINT)
+        _write_varint(buf, d)
+    for d in pc.device_ids:
+        _write_tag(buf, 4, _WIRE_VARINT)
+        _write_varint(buf, d)
+    return buf.getvalue()
+
+
+def _decode_op(data: bytes) -> Tuple[str, ParallelConfig]:
+    pos = 0
+    name = ""
+    device_type = DeviceType.TPU
+    dims: List[int] = []
+    device_ids: List[int] = []
+    while pos < len(data):
+        tag, pos = _read_varint(data, pos)
+        field, wire = tag >> 3, tag & 0x7
+        if wire == _WIRE_VARINT:
+            val, pos = _read_varint(data, pos)
+            if field == 2:
+                device_type = DeviceType.CPU if val == 1 else DeviceType.TPU
+            elif field == 3:
+                dims.append(int(val))
+            elif field == 4:
+                device_ids.append(int(val))
+        elif wire == _WIRE_LEN:
+            ln, pos = _read_varint(data, pos)
+            payload = data[pos:pos + ln]
+            pos += ln
+            if field == 1:
+                name = payload.decode("utf-8")
+            elif field in (3, 4, 5):  # packed repeated ints
+                p = 0
+                while p < len(payload):
+                    v, p = _read_varint(payload, p)
+                    if field == 3:
+                        dims.append(int(v))
+                    elif field == 4:
+                        device_ids.append(int(v))
+        else:
+            raise ValueError(f"unsupported wire type {wire} in strategy file")
+    if not dims:
+        dims = [1]
+    return name, ParallelConfig(device_type, tuple(dims), tuple(device_ids))
+
+
+def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]) -> None:
+    """Serialize (reference: strategy.cc:128-163)."""
+    buf = io.BytesIO()
+    for name, pc in strategies.items():
+        body = _encode_op(name, pc)
+        _write_tag(buf, 1, _WIRE_LEN)
+        _write_varint(buf, len(body))
+        buf.write(body)
+    with open(filename, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def load_strategies_from_file(filename: str, reference_order: bool = False) -> Dict[str, ParallelConfig]:
+    """Parse (reference: strategy.cc:87-126).  ``reference_order=True``
+    reverses each op's dims from Legion adim order into natural order."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    out: Dict[str, ParallelConfig] = {}
+    pos = 0
+    while pos < len(data):
+        tag, pos = _read_varint(data, pos)
+        field, wire = tag >> 3, tag & 0x7
+        if wire != _WIRE_LEN:
+            raise ValueError("malformed strategy file")
+        ln, pos = _read_varint(data, pos)
+        payload = data[pos:pos + ln]
+        pos += ln
+        if field == 1:
+            name, pc = _decode_op(payload)
+            if reference_order:
+                pc = ParallelConfig(pc.device_type, tuple(reversed(pc.dims)), pc.device_ids)
+            out[name] = pc
+    return out

@@ -1,0 +1,92 @@
+"""Data loading.
+
+Reference: per-app ``DataLoader`` (examples/cpp/AlexNet/alexnet.cc:145-343)
+and the generic Python loaders (python/flexflow_dataloader.{h,cc,cu}).  The
+reference pattern is: load the entire dataset once into host zero-copy
+memory, then each ``next_batch`` index-launches a scatter of this batch's
+samples into the input tensor's partition.
+
+TPU-native: the full dataset stays in host numpy (the ZC-memory analogue);
+``next_batch`` slices the next batch and ``jax.device_put``s it directly
+with the input tensor's NamedSharding, so each chip receives exactly its
+shard over PCIe/DMA — the analogue of the per-GPU scatter task.  A
+synthetic mode generates the dataset once from a fixed seed (the
+reference's primary benchmark fixture, alexnet.cc:152-155).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..tensor import DataType, Tensor
+
+
+class DataLoader:
+    """Generic multi-input loader (analogue of SingleDataLoader /
+    ImgDataLoader in python/flexflow_dataloader.cc plus the per-app C++
+    loaders)."""
+
+    def __init__(self, ff, inputs: Dict[Tensor, np.ndarray],
+                 labels: np.ndarray, shuffle: bool = False, seed: int = 0):
+        self.ff = ff
+        self.inputs = {t: np.ascontiguousarray(self._to_native(t, a))
+                       for t, a in inputs.items()}
+        self.labels = np.ascontiguousarray(labels)
+        sizes = {a.shape[0] for a in self.inputs.values()} | {labels.shape[0]}
+        if len(sizes) != 1:
+            raise ValueError(f"inconsistent sample counts: {sizes}")
+        self.num_samples = labels.shape[0]
+        self.batch_size = ff.config.batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._order = np.arange(self.num_samples)
+        self.next_index = 0
+
+    @staticmethod
+    def _to_native(t: Tensor, a: np.ndarray) -> np.ndarray:
+        """Accept reference-layout (NCHW) image datasets and convert once
+        to the framework's NHWC layout on host."""
+        if a.ndim == 4 and len(t.dims) == 4 and a.shape[1:] != t.dims[1:]:
+            n, c, h, w = a.shape
+            if (h, w, c) == tuple(t.dims[1:]):
+                return a.transpose(0, 2, 3, 1)
+        return a
+
+    @classmethod
+    def synthetic(cls, ff, input_tensor: Tensor, label_tensor: Optional[Tensor] = None,
+                  num_samples: Optional[int] = None, num_classes: int = 10,
+                  seed: int = 17) -> "DataLoader":
+        """Random dataset generated once (reference synthetic mode)."""
+        label_tensor = label_tensor or ff.label_tensor
+        num_samples = num_samples or ff.config.batch_size
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((num_samples,) + tuple(input_tensor.dims[1:]),
+                                dtype=np.float32)
+        if label_tensor.dtype == DataType.INT32:
+            y = rng.integers(0, num_classes,
+                             size=(num_samples,) + tuple(label_tensor.dims[1:]),
+                             dtype=np.int32)
+        else:
+            y = rng.standard_normal((num_samples,) + tuple(label_tensor.dims[1:]),
+                                    dtype=np.float32)
+        return cls(ff, {input_tensor: x}, y)
+
+    def reset(self) -> None:
+        self.next_index = 0
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def next_batch(self, ff=None) -> None:
+        ff = ff or self.ff
+        b = self.batch_size
+        if self.next_index + b > self.num_samples:
+            self.next_index = 0
+        sel = self._order[self.next_index:self.next_index + b]
+        self.next_index += b
+        ff.set_batch({t: a[sel] for t, a in self.inputs.items()},
+                     self.labels[sel])

@@ -1,0 +1,6 @@
+"""Execution simulator + MCMC strategy search.
+
+TPU-native analogue of the reference simulator stack
+(reference: include/simulator.h, src/runtime/simulator.{cc,cu},
+FFModel::optimize model.cc:1056-1107).
+"""

@@ -1,0 +1,10 @@
+"""MCMC strategy search entry point (placeholder until the simulator
+milestone lands — see simulator/ package docstring)."""
+
+from __future__ import annotations
+
+
+def mcmc_search(model, budget: int, alpha: float):
+    raise NotImplementedError(
+        "strategy search requires the execution simulator; "
+        "it is being built — run without --budget for now")

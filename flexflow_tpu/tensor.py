@@ -1,0 +1,102 @@
+"""Graph-time tensor and parameter descriptors.
+
+TPU-native analogue of the reference ``Tensor``/``Parameter`` structs
+(reference: include/model.h:131-181).  The reference Tensor owns Legion
+logical regions and partitions; here a Tensor is purely symbolic — a node
+edge in the op graph carrying shape/dtype/producer.  Physical placement is
+decided at compile time by lowering each op's ``ParallelConfig`` to a
+``jax.sharding.NamedSharding``; XLA GSPMD materializes the shards.
+
+Layout convention (TPU-first): image tensors are **NHWC** (channels last,
+so the channel dim rides the 128-wide lane dimension of the VPU/MXU).  The
+reference is NCHW (Legion adim reversed); the public ``create_tensor`` API
+still accepts reference-ordered dims and converts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional, Tuple
+
+import numpy as np
+
+_guid_counter = itertools.count(100)
+
+
+class DataType:
+    """Dtype tags mirroring the reference enum (include/model.h)."""
+
+    FLOAT = "float32"
+    DOUBLE = "float64"
+    INT32 = "int32"
+    INT64 = "int64"
+    BOOL = "bool"
+    HALF = "bfloat16"  # TPU-native half precision
+
+
+@dataclasses.dataclass(eq=False)
+class Tensor:
+    """A symbolic activation in the op graph.
+
+    ``dims`` is the full shape including the batch dim, natural order
+    (batch first, NHWC for images).  ``owner_op`` is the producing op
+    (None for graph inputs), ``owner_idx`` its output slot — mirroring
+    ``Tensor::owner_op/owner_idx`` (include/model.h:160-162).
+    """
+
+    dims: Tuple[int, ...]
+    dtype: str = DataType.FLOAT
+    owner_op: Optional[object] = None
+    owner_idx: int = 0
+    name: str = ""
+
+    def __post_init__(self):
+        self.guid = next(_guid_counter)
+        self.dims = tuple(int(d) for d in self.dims)
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.dims)
+
+    @property
+    def batch_size(self) -> int:
+        return self.dims[0]
+
+    def volume(self) -> int:
+        return int(np.prod(self.dims))
+
+    def __repr__(self):
+        own = type(self.owner_op).__name__ if self.owner_op is not None else "input"
+        return f"Tensor(guid={self.guid}, dims={self.dims}, {self.dtype}, from={own})"
+
+
+@dataclasses.dataclass(eq=False)
+class Parameter:
+    """A trainable weight owned by an op (reference: include/model.h:169-181).
+
+    ``initializer`` is an ``initializers.Initializer``; ``spec_dims`` maps
+    each weight dim to the op-config dim index it is partitioned along
+    (None → replicated), used when lowering to a NamedSharding.
+    """
+
+    name: str
+    dims: Tuple[int, ...]
+    dtype: str = DataType.FLOAT
+    initializer: Optional[object] = None
+    owner_op: Optional[object] = None
+    # For each weight dim: index into the op's ParallelConfig.dims that
+    # partitions this dim, or None if replicated over that mesh axis group.
+    partition_dims: Tuple[Optional[int], ...] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        self.guid = next(_guid_counter)
+        self.dims = tuple(int(d) for d in self.dims)
+        if self.partition_dims is None:
+            self.partition_dims = (None,) * len(self.dims)
+
+    def volume(self) -> int:
+        return int(np.prod(self.dims))
+
+    def __repr__(self):
+        return f"Parameter({self.name}, dims={self.dims}, {self.dtype})"

@@ -1,0 +1,30 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+The reference has no way to test multi-node without a cluster (SURVEY.md
+§4); this framework tests every sharding path on a fake mesh of 8 CPU
+devices via --xla_force_host_platform_device_count, so the full SOAP
+strategy space is exercised in CI with no TPU attached.
+"""
+
+import os
+
+# Must be set before the XLA CPU client initializes.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-selects the TPU backend at interpreter boot
+# (jax.config.update('jax_platforms', 'axon,cpu')); tests run on the
+# virtual CPU mesh regardless.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

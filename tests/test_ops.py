@@ -1,0 +1,178 @@
+"""Per-op numerics vs. independent references (torch CPU / numpy).
+
+The reference validates ops only end-to-end (SURVEY.md §4); here each op is
+unit-tested against torch.nn.functional (layout-converted NCHW↔NHWC) or
+closed-form numpy.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+import flexflow_tpu as ff
+from flexflow_tpu.ops.base import FwdCtx
+
+
+def run_op(op, params, *xs, training=False, rng=None):
+    ctx = FwdCtx(training=training, rng=rng,
+                 stats_in={op.name: op.init_stats()} if op.init_stats() else {},
+                 stats_out={} if training else None)
+    return op.forward(params, list(xs), ctx)[0]
+
+
+def make_model(batch=4):
+    return ff.FFModel(ff.FFConfig(batch_size=batch, workers_per_node=1))
+
+
+def test_conv2d_matches_torch():
+    m = make_model()
+    inp = m.create_tensor((4, 3, 16, 16))  # reference NCHW order
+    out = m.conv2d(inp, 8, 3, 3, 2, 2, 1, 1)
+    op = m.ops[0]
+    assert out.dims == (4, 8, 8, 8)  # NHWC: (N, H', W', C)
+
+    rng = np.random.default_rng(0)
+    x_nchw = rng.standard_normal((4, 3, 16, 16), dtype=np.float32)
+    k_hwio = rng.standard_normal((3, 3, 3, 8), dtype=np.float32)
+    b = rng.standard_normal((8,), dtype=np.float32)
+
+    y = run_op(op, {"kernel": jnp.asarray(k_hwio), "bias": jnp.asarray(b)},
+               jnp.asarray(x_nchw.transpose(0, 2, 3, 1)))
+    y_ref = F.conv2d(torch.from_numpy(x_nchw),
+                     torch.from_numpy(k_hwio.transpose(3, 2, 0, 1)),
+                     torch.from_numpy(b), stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(y).transpose(0, 3, 1, 2),
+                               y_ref.numpy(), rtol=2e-5, atol=2e-5)
+
+
+def test_conv2d_shape_formula():
+    # out = 1 + (in + 2p - k)/s  (reference conv_2d.cu:100-101)
+    m = make_model()
+    inp = m.create_tensor((4, 3, 229, 229))
+    t = m.conv2d(inp, 64, 11, 11, 4, 4, 2, 2)
+    assert t.dims == (4, 56, 56, 64)
+
+
+def test_pool2d_max_matches_torch():
+    m = make_model()
+    inp = m.create_tensor((2, 4, 13, 13))
+    out = m.pool2d(inp, 3, 3, 2, 2, 0, 0)
+    assert out.dims == (2, 6, 6, 4)
+    x = np.random.default_rng(1).standard_normal((2, 4, 13, 13), dtype=np.float32)
+    y = run_op(m.ops[0], {}, jnp.asarray(x.transpose(0, 2, 3, 1)))
+    y_ref = F.max_pool2d(torch.from_numpy(x), 3, 2)
+    np.testing.assert_allclose(np.asarray(y).transpose(0, 3, 1, 2), y_ref.numpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_pool2d_avg_excludes_padding():
+    m = make_model()
+    inp = m.create_tensor((1, 1, 4, 4))
+    m.pool2d(inp, 3, 3, 2, 2, 1, 1, pool_type=ff.PoolType.AVG)
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    y = run_op(m.ops[0], {}, jnp.asarray(x.transpose(0, 2, 3, 1)))
+    y_ref = F.avg_pool2d(torch.from_numpy(x), 3, 2, padding=1,
+                         count_include_pad=False)
+    np.testing.assert_allclose(np.asarray(y).transpose(0, 3, 1, 2), y_ref.numpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_linear_matches_numpy():
+    m = make_model()
+    inp = m.create_tensor((4, 32))
+    out = m.dense(inp, 16, activation=ff.ActiMode.RELU)
+    assert out.dims == (4, 16)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 32), dtype=np.float32)
+    w = rng.standard_normal((32, 16), dtype=np.float32)
+    b = rng.standard_normal((16,), dtype=np.float32)
+    y = run_op(m.ops[0], {"kernel": jnp.asarray(w), "bias": jnp.asarray(b)}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), np.maximum(x @ w + b, 0), rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_sum_avg():
+    m = make_model()
+    inp = m.create_tensor((3, 5), dtype=ff.DataType.INT32, nchw=False)
+    m.embedding(inp, num_entries=20, out_dim=6, aggr=ff.AggrMode.SUM)
+    table = np.random.default_rng(3).standard_normal((20, 6), dtype=np.float32)
+    idx = np.array([[0, 1, 2, 3, 4], [5, 5, 5, 5, 5], [19, 0, 19, 0, 1]], np.int32)
+    y = run_op(m.ops[0], {"weight": jnp.asarray(table)}, jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(y), table[idx].sum(1), rtol=1e-6, atol=1e-6)
+
+    m2 = make_model()
+    inp2 = m2.create_tensor((3, 5), dtype=ff.DataType.INT32, nchw=False)
+    m2.embedding(inp2, 20, 6, aggr=ff.AggrMode.AVG)
+    y2 = run_op(m2.ops[0], {"weight": jnp.asarray(table)}, jnp.asarray(idx))
+    np.testing.assert_allclose(np.asarray(y2), table[idx].mean(1), rtol=1e-6, atol=1e-6)
+
+
+def test_flat_softmax_concat_elementwise():
+    m = make_model()
+    inp = m.create_tensor((2, 3, 4, 4))
+    t = m.flat(inp)
+    assert t.dims == (2, 48)
+
+    x = np.random.default_rng(4).standard_normal((2, 4, 4, 3), dtype=np.float32)
+    y = run_op(m.ops[0], {}, jnp.asarray(x))
+    assert y.shape == (2, 48)
+
+    # softmax
+    sm = make_model()
+    si = sm.create_tensor((2, 10), nchw=False)
+    sm.softmax(si)
+    logits = np.random.default_rng(5).standard_normal((2, 10), dtype=np.float32)
+    p = run_op(sm.ops[0], {}, jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(p), F.softmax(torch.from_numpy(logits), -1).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    # concat channel axis: reference axis=1 (NCHW) → native 3
+    cm = make_model()
+    a = cm.create_tensor((2, 3, 4, 4))
+    b = cm.create_tensor((2, 5, 4, 4))
+    out = cm.concat([a, b], axis=1)
+    assert out.dims == (2, 4, 4, 8)
+
+    # element binary
+    em = make_model()
+    u = em.create_tensor((2, 6), nchw=False)
+    v = em.create_tensor((2, 6), nchw=False)
+    em.add(u, v)
+    xu = np.ones((2, 6), np.float32)
+    xv = np.full((2, 6), 2.0, np.float32)
+    y = run_op(em.ops[0], {}, jnp.asarray(xu), jnp.asarray(xv))
+    np.testing.assert_allclose(np.asarray(y), xu + xv)
+
+
+def test_batchnorm_train_matches_torch():
+    m = make_model()
+    inp = m.create_tensor((4, 3, 8, 8))
+    m.batch_norm(inp, relu=True)
+    op = m.ops[0]
+    x = np.random.default_rng(6).standard_normal((4, 3, 8, 8), dtype=np.float32)
+    scale = np.array([1.5, 0.5, 2.0], np.float32)
+    bias = np.array([0.1, -0.2, 0.0], np.float32)
+    y = run_op(op, {"scale": jnp.asarray(scale), "bias": jnp.asarray(bias)},
+               jnp.asarray(x.transpose(0, 2, 3, 1)), training=True)
+    bn = F.batch_norm(torch.from_numpy(x), None, None,
+                      torch.from_numpy(scale), torch.from_numpy(bias),
+                      training=True, eps=1e-5)
+    np.testing.assert_allclose(np.asarray(y).transpose(0, 3, 1, 2),
+                               F.relu(bn).numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_train_and_eval():
+    m = make_model()
+    inp = m.create_tensor((8, 100), nchw=False)
+    m.dropout(inp, rate=0.5)
+    op = m.ops[0]
+    x = jnp.ones((8, 100))
+    y_eval = run_op(op, {}, x, training=False)
+    np.testing.assert_allclose(np.asarray(y_eval), np.ones((8, 100)))
+    y_tr = run_op(op, {}, x, training=True, rng=jax.random.key(0))
+    arr = np.asarray(y_tr)
+    assert set(np.unique(arr)).issubset({0.0, 2.0})
+    assert 0.3 < (arr == 0).mean() < 0.7

@@ -1,0 +1,122 @@
+"""SOAP strategy lowering + cross-strategy numerical equivalence.
+
+The reference's core magic is per-op hybrid parallelization with implicit
+resharding between differently-partitioned ops (SURVEY.md §2.3, §7).  On
+the 8-device virtual mesh these tests check:
+  * ParallelConfig → PartitionSpec lowering (mesh axes factoring),
+  * weights are actually sharded on device (tensor parallel dense),
+  * a training run under ANY strategy (DP / TP / spatial / hybrid) yields
+    numerically equivalent results to single-device execution — the
+    analogue of the reference's "strategy changes placement, not math"
+    contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+import flexflow_tpu as ff
+from flexflow_tpu.parallel.mesh import Machine
+
+
+def test_mesh_factoring(devices):
+    mach = Machine(devices)
+    assert mach.num_devices == 8
+    assert sorted(mach.axis_sizes) == [2, 2, 2]
+    spec = mach.spec_for_config(ff.ParallelConfig(dims=(4, 1, 2, 1)))
+    assert spec == PartitionSpec(("m0", "m1"), None, "m2")
+    spec2 = mach.spec_for_config(ff.ParallelConfig(dims=(8, 1)))
+    assert spec2 == PartitionSpec(("m0", "m1", "m2"))
+    spec3 = mach.spec_for_config(ff.ParallelConfig(dims=(1, 1)))
+    assert spec3 == PartitionSpec()
+    with pytest.raises(ValueError):
+        mach.axes_for_degrees([3])
+
+
+def build_and_train(strategies, batch=16, steps=6, seed=3):
+    cfg = ff.FFConfig(batch_size=batch, strategies=dict(strategies))
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((batch, 3, 12, 12))
+    t = m.conv2d(inp, 8, 3, 3, 1, 1, 1, 1, activation=ff.ActiMode.RELU, name="conv1")
+    t = m.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+    t = m.flat(t, name="flat1")
+    t = m.dense(t, 32, activation=ff.ActiMode.RELU, name="fc1")
+    t = m.dense(t, 10, name="fc2")
+    t = m.softmax(t, name="softmax1")
+    m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+              ["accuracy", "sparse_categorical_crossentropy"])
+    m.init_layers(seed=seed)
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((batch * 2, 3, 12, 12), dtype=np.float32)
+    y = rng.integers(0, 10, size=(batch * 2, 1), dtype=np.int32)
+    dl = ff.DataLoader(m, {inp: x}, y)
+    losses = []
+    for _ in range(steps):
+        dl.next_batch(m)
+        m.train_iteration()
+    m._drain_metrics()
+    fc2 = m.get_parameter("fc2", "kernel")
+    conv1 = m.get_parameter("conv1", "kernel")
+    return fc2, conv1, m
+
+
+DP8 = {
+    "conv1": ff.ParallelConfig(dims=(8, 1, 1, 1)),
+    "pool1": ff.ParallelConfig(dims=(8, 1, 1, 1)),
+    "flat1": ff.ParallelConfig(dims=(8, 1)),
+    "fc1": ff.ParallelConfig(dims=(8, 1)),
+    "fc2": ff.ParallelConfig(dims=(8, 1)),
+    "softmax1": ff.ParallelConfig(dims=(8, 1)),
+}
+
+# Hybrid SOAP: conv spatially split (sample×height), dense tensor-parallel.
+HYBRID = {
+    "conv1": ff.ParallelConfig(dims=(2, 2, 2, 1)),
+    "pool1": ff.ParallelConfig(dims=(2, 2, 1, 1)),
+    "flat1": ff.ParallelConfig(dims=(2, 1)),
+    "fc1": ff.ParallelConfig(dims=(2, 4)),   # tensor parallel over out dim
+    "fc2": ff.ParallelConfig(dims=(2, 1)),
+    "softmax1": ff.ParallelConfig(dims=(2, 1)),
+}
+
+SINGLE = {
+    name: ff.ParallelConfig(dims=(1,) * nd)
+    for name, nd in [("conv1", 4), ("pool1", 4), ("flat1", 2),
+                     ("fc1", 2), ("fc2", 2), ("softmax1", 2)]
+}
+
+
+def test_tensor_parallel_dense_is_sharded(devices):
+    _, _, m = build_and_train(HYBRID, steps=1)
+    k = m._params["fc1"]["kernel"]
+    # out-dim split 4 ways → each device holds a (in, out/4) shard
+    shard_shape = k.sharding.shard_shape(k.shape)
+    assert shard_shape[1] == k.shape[1] // 4
+
+
+@pytest.mark.parametrize("strategy", [DP8, HYBRID], ids=["dp8", "hybrid"])
+def test_strategy_equivalence(devices, strategy):
+    """Any SOAP strategy must compute the same training trajectory as
+    single-device execution (up to float reassociation)."""
+    fc2_a, conv_a, _ = build_and_train(SINGLE)
+    fc2_b, conv_b, _ = build_and_train(strategy)
+    np.testing.assert_allclose(fc2_a, fc2_b, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(conv_a, conv_b, rtol=5e-4, atol=5e-5)
+
+
+def test_import_export_strategy_file(devices, tmp_path):
+    path = str(tmp_path / "st.pb")
+    ff.save_strategies_to_file(path, HYBRID)
+    cfg = ff.FFConfig(batch_size=16, import_strategy_file=path)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 3, 12, 12))
+    t = m.conv2d(inp, 8, 3, 3, 1, 1, 1, 1, name="conv1")
+    t = m.flat(t, name="flat1")
+    t = m.dense(t, 10, name="fc1")
+    m.softmax(t, name="softmax1")
+    m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy", ["accuracy"])
+    assert m.ops[0].pc.dims == (2, 2, 2, 1)
+    assert m.ops[2].pc.dims == (2, 4)
