@@ -1,0 +1,35 @@
+"""Shared example driver: the reference's canonical train loop
+(examples/cpp/AlexNet/alexnet.cc:97-130) — warmup/compile, epoch loop,
+ELAPSED TIME / THROUGHPUT printout."""
+
+import time
+
+
+def train_and_report(model, data_loader, cfg, reuse_first_batch=True):
+    data_loader.next_batch(model)
+    model.train_iteration()  # compile + warmup (≈ Legion trace capture)
+    model.sync()
+    model.reset_metrics()
+
+    ts_start = time.perf_counter()
+    for epoch in range(cfg.epochs):
+        data_loader.reset()
+        model.reset_metrics()
+        model.optimizer.next_epoch()
+        iterations = data_loader.num_samples // cfg.batch_size
+        for it in range(iterations):
+            if not (reuse_first_batch and cfg.dataset_path == ""):
+                data_loader.next_batch(model)
+            elif it == 0 and epoch == 0:
+                data_loader.next_batch(model)
+            model.forward()
+            model.zero_gradients()
+            model.backward()
+            model.update()
+    model.sync()
+    run_time = time.perf_counter() - ts_start
+    model.print_metrics()
+    num_samples = data_loader.num_samples * cfg.epochs
+    print(f"ELAPSED TIME = {run_time:.4f}s, THROUGHPUT = "
+          f"{num_samples / run_time:.2f} samples/s")
+    return num_samples / run_time

@@ -1,0 +1,32 @@
+"""InceptionV3 training example (reference: examples/cpp/InceptionV3).
+
+    python examples/inception.py -e 1 -b 32 --bf16
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.inception import build_inception_v3
+from examples.common import train_and_report
+
+
+def main(argv=None):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    print(f"batchSize({cfg.batch_size}) workersPerNodes({cfg.workers_per_node}) "
+          f"numNodes({cfg.num_nodes})")
+    model = ff.FFModel(cfg)
+    inp, _ = build_inception_v3(model, cfg.batch_size)
+    model.compile(ff.SGDOptimizer(model, lr=0.001),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY,
+                   ff.MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY])
+    dl = ff.DataLoader.synthetic(model, inp, num_samples=cfg.batch_size * 2)
+    model.init_layers()
+    return train_and_report(model, dl, cfg)
+
+
+if __name__ == "__main__":
+    main()

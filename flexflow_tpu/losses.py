@@ -60,9 +60,15 @@ class Loss:
         )
 
     def __call__(self, preds: jax.Array, labels: jax.Array) -> jax.Array:
-        """preds: (B, C) logits for CE losses, final outputs for MSE.
-        labels: (B,) or (B,1) int for sparse CE; (B, C) otherwise."""
+        """preds: (B, C) logits for CE losses, final outputs for MSE —
+        or (B, T, C) for sequence models (NMT), reduced per-token.
+        labels: (B,)/(B,1) [or (B,T)] int for sparse CE; matching shape
+        otherwise."""
         preds = preds.astype(jnp.float32)
+        if preds.ndim > 2:  # sequence logits: fold time into the batch dim
+            preds = preds.reshape(-1, preds.shape[-1])
+            labels = labels.reshape(preds.shape[0], -1) \
+                if labels.ndim > 1 and labels.size != preds.shape[0] else labels
         batch = preds.shape[0]
         if self.loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
             labels = labels.reshape(batch).astype(jnp.int32)

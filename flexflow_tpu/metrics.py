@@ -95,6 +95,10 @@ class Metrics:
 
     def compute(self, probs: jax.Array, labels: jax.Array) -> Dict[str, jax.Array]:
         probs = probs.astype(jnp.float32)
+        if probs.ndim > 2:  # sequence outputs: per-token metrics
+            probs = probs.reshape(-1, probs.shape[-1])
+            labels = labels.reshape(probs.shape[0], -1) \
+                if self.sparse else labels.reshape(probs.shape)
         batch, num_classes = probs.shape[0], probs.shape[-1]
         out: Dict[str, jax.Array] = {"train_all": jnp.int32(batch)}
         m = self.metrics

@@ -136,9 +136,20 @@ class FFModel:
 
     def embedding(self, input_tensor: Tensor, num_entries: int, out_dim: int,
                   aggr: str = AggrMode.SUM, kernel_initializer=None,
-                  name: Optional[str] = None) -> Tensor:
+                  share_with=None, name: Optional[str] = None) -> Tensor:
         return self._append(Embedding(self, input_tensor, num_entries, out_dim,
-                                      aggr, kernel_initializer, name))
+                                      aggr, kernel_initializer, share_with, name))
+
+    def lstm(self, input_tensor: Tensor, hidden_size: int, hx: Optional[Tensor] = None,
+             cx: Optional[Tensor] = None, share_with=None,
+             name: Optional[str] = None):
+        """Sequence LSTM (B,T,E)→(B,T,H); returns (y, h_T, c_T) tensors.
+        Reference: nmt/lstm.cu chunk op + SharedVariable weight sharing."""
+        from .ops.lstm import LSTM
+
+        op = LSTM(self, input_tensor, hidden_size, hx, cx, share_with, name)
+        self.ops.append(op)
+        return op.outputs[0], op.outputs[1], op.outputs[2]
 
     def concat(self, tensors: Sequence[Tensor], axis: int,
                name: Optional[str] = None) -> Tensor:
@@ -235,7 +246,7 @@ class FFModel:
             pc = cfg.find_parallel_config(op.output.num_dims, op.name)
             if pc.num_parts() > nd:
                 pc = ParallelConfig.data_parallel(op.output.num_dims, nd)
-            op.pc = pc
+            op.pc = self._legalize_pc(op, pc)
 
         # Export AFTER resolution so imported/searched configs are what get
         # written (reference exports from FFConfig::strategies the same way).
@@ -245,13 +256,33 @@ class FFModel:
         # Label tensor (reference creates it in compile; dims follow loss).
         logits = self._loss_input_tensor()
         if self.loss.loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
-            self.label_tensor = Tensor((logits.dims[0], 1), DataType.INT32, name="label")
+            # (B, 1) for classifiers (reference convention), (B, T) for
+            # sequence models.
+            ldims = logits.dims[:-1] if logits.num_dims > 2 else (logits.dims[0], 1)
+            self.label_tensor = Tensor(ldims, DataType.INT32, name="label")
         else:
             self.label_tensor = Tensor(tuple(self.final_tensor().dims), DataType.FLOAT, name="label")
 
         self._compiled = True
         self._train_step_fn = None
         self._eval_step_fn = None
+
+    def _legalize_pc(self, op: Op, pc: ParallelConfig) -> ParallelConfig:
+        """Clamp each dim's partition degree to a divisor of the dim size
+        (a tiny batch can't split over the whole mesh; the reference simply
+        asserts — we degrade to the largest legal degree)."""
+        import math
+
+        dims = list(pc.dims)
+        changed = False
+        for i, d in enumerate(dims):
+            if i < op.output.num_dims and op.output.dims[i] % d != 0:
+                dims[i] = math.gcd(d, op.output.dims[i])
+                changed = True
+        if not changed:
+            return pc
+        npc = ParallelConfig(pc.device_type, tuple(dims))
+        return npc.with_device_ids(tuple(range(npc.num_parts())))
 
     def _all_strategies(self) -> Dict[str, ParallelConfig]:
         return {op.name: getattr(op, "pc", ParallelConfig.data_parallel(
@@ -355,7 +386,7 @@ class FFModel:
                      stats_out={} if training else None)
         for op in self.ops:
             xs = [env[t.guid] for t in op.inputs]
-            pvals = params.get(op.name, {})
+            pvals = params.get(op.param_key, {})
             ys = op.forward(pvals, xs, ctx)
             if multi:
                 ys = [self.machine.constraint(y, op.pc) for y in ys]
@@ -398,6 +429,7 @@ class FFModel:
                 loss_fn, has_aux=True)(params)
             msum = metrics.compute(probs, labels)
             msum["loss"] = loss
+            msum["steps"] = 1.0
             # On-device metric accumulation: one small vector rides along
             # and is fetched once per drain — the analogue of the
             # reference's future-chain metric fold (model.cc:1145-1167)
@@ -456,7 +488,7 @@ class FFModel:
 
     def _metric_keys(self) -> List[str]:
         return ["train_all", "train_correct", "cce_loss", "sparse_cce_loss",
-                "mse_loss", "rmse_loss", "mae_loss", "loss"]
+                "mse_loss", "rmse_loss", "mae_loss", "loss", "steps"]
 
     def update(self) -> None:
         assert self._batch is not None, "no batch loaded: call a DataLoader first"
@@ -499,7 +531,10 @@ class FFModel:
         if self._metric_acc is not None:
             vec = jax.device_get(self._metric_acc)  # single small transfer
             totals = dict(zip(self._metric_keys(), [float(v) for v in vec]))
-            self.last_loss = totals.pop("loss", None)
+            steps = totals.pop("steps", 0.0)
+            loss_sum = totals.pop("loss", None)
+            if steps > 0 and loss_sum is not None:
+                self.last_loss = loss_sum / steps  # mean loss since last drain
             self.current_metrics.update(totals)
             self._metric_acc = jnp.zeros_like(self._metric_acc)
 

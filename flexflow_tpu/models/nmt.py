@@ -1,0 +1,66 @@
+"""NMT LSTM seq2seq (reference: nmt/ mini-framework, 3602 LoC).
+
+Reference defaults (nmt/nmt.cc:34-44): bs=64/worker, 2 layers, seq 20,
+hidden=embed=2048, vocab 20k.  The reference builds a grid of 10-step LSTM
+chunk ops placed on specific GPUs (operator/pipeline parallelism over the
+sequence, nmt/nmt.cc:269-308) with SharedVariable param-server weight sync.
+
+TPU-native re-design: full-sequence scan-based LSTM ops (ops/lstm.py) with
+graph-level weight sharing; encoder final state seeds the decoder; vocab
+projection is a single (B·T, H)×(H, V) MXU matmul; softmax+CE fuse in the
+loss.  Sequence scaling on TPU comes from batch/sequence sharding and ring
+attention (parallel/ring.py) rather than chunk placement.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..model import FFModel
+
+
+def build_nmt(ff: FFModel, batch_size: int, seq_length: int = 20,
+              num_layers: int = 2, hidden_size: int = 2048,
+              embed_size: int = 2048, vocab_size: int = 20 * 1024):
+    """Returns (src_tensor, dst_tensor, softmax_output).
+
+    Labels are the decoder targets, shape (B, seq_length) int32.
+    """
+    src = ff.create_tensor((batch_size, seq_length), name="src",
+                           dtype="int32", nchw=False)
+    dst = ff.create_tensor((batch_size, seq_length), name="dst",
+                           dtype="int32", nchw=False)
+
+    from ..ops.embedding import AggrMode
+
+    src_emb = ff.embedding(src, vocab_size, embed_size, aggr=AggrMode.NONE,
+                           name="embed_src")
+    embed_op = ff.ops[-1]
+    dst_emb = ff.embedding(dst, vocab_size, embed_size, aggr=AggrMode.NONE,
+                           share_with=embed_op, name="embed_dst")
+
+    # Encoder stack; each layer's final (h, c) seeds the decoder layer.
+    enc = src_emb
+    states = []
+    for layer in range(num_layers):
+        enc, h, c = ff.lstm(enc, hidden_size, name=f"enc_lstm{layer}")
+        states.append((h, c))
+    dec = dst_emb
+    for layer in range(num_layers):
+        h, c = states[layer]
+        dec, _, _ = ff.lstm(dec, hidden_size, hx=h, cx=c,
+                            name=f"dec_lstm{layer}")
+
+    logits = ff.dense(dec, vocab_size, name="vocab_proj")
+    out = ff.softmax(logits, name="softmax_dp")
+    return src, dst, out
+
+
+def synthetic_batch(batch_size: int, seq_length: int, vocab_size: int, seed: int = 5):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, vocab_size, size=(batch_size, seq_length), dtype=np.int32)
+    dst = rng.integers(0, vocab_size, size=(batch_size, seq_length), dtype=np.int32)
+    labels = rng.integers(0, vocab_size, size=(batch_size, seq_length), dtype=np.int32)
+    return src, dst, labels

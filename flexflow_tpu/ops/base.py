@@ -56,6 +56,15 @@ class Op:
         self.weights: List[Parameter] = []
         self.outputs: List[Tensor] = []
         self.profiling = False
+        # Weight sharing (reference: NMT SharedVariable nmt/rnn.h:37-51 and
+        # the FFModel ops' weight_sharing argument): when set, this op has
+        # no weights of its own and reads the owner op's parameters.
+        self.share_from: Optional["Op"] = None
+
+    @property
+    def param_key(self) -> str:
+        """Key into the params pytree: the owning op's name."""
+        return self.share_from.name if self.share_from is not None else self.name
 
     # -- graph construction ------------------------------------------------
     def _add_output(self, dims, dtype="float32") -> Tensor:

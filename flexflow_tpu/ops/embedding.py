@@ -37,7 +37,7 @@ class Embedding(Op):
 
     def __init__(self, model, input_tensor, num_entries: int, out_dim: int,
                  aggr: str = AggrMode.SUM, kernel_initializer=None,
-                 name: Optional[str] = None):
+                 share_with=None, name: Optional[str] = None):
         super().__init__(model, [input_tensor], name)
         self.num_entries = num_entries
         self.out_dim = out_dim
@@ -51,9 +51,15 @@ class Embedding(Op):
                 self._add_output((batch, out_dim), "float32")
         else:
             self._add_output((batch, out_dim), "float32")
-        self._add_weight("weight", (num_entries, out_dim),
-                         kernel_initializer or GlorotUniform(),
-                         partition_dims=(None, len(self.output.dims) - 1))
+        if share_with is not None:
+            if not isinstance(share_with, Embedding) or \
+                    (share_with.num_entries, share_with.out_dim) != (num_entries, out_dim):
+                raise ValueError("share_with must be an Embedding of identical shape")
+            self.share_from = share_with
+        else:
+            self._add_weight("weight", (num_entries, out_dim),
+                             kernel_initializer or GlorotUniform(),
+                             partition_dims=(None, len(self.output.dims) - 1))
 
     def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
         idx = xs[0].astype(jnp.int32)

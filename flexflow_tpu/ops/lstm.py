@@ -1,0 +1,95 @@
+"""LSTM operator — the NMT workhorse.
+
+Reference: nmt/lstm.cu (cudnnRNN over 10-step chunks; weights shared across
+chunks via the SharedVariable param-server, nmt/rnn.h:37-51).
+
+TPU-native design: the input projection for ALL timesteps is one large
+(B·T, E)×(E, 4H) matmul (MXU-saturating), and only the recurrent
+h×(H, 4H) product runs inside ``lax.scan`` — the idiomatic XLA recurrence
+(static trip count, no dynamic shapes).  Weight sharing between ops
+(reference SharedVariable) is the graph-level ``share_with`` mechanism:
+a sharing op reads the owner op's parameters.
+
+Gate order (i, f, g, o); accumulation in float32.
+
+Inputs:  x (B, T, E) [+ optional h0 (B, H), c0 (B, H)]
+Outputs: y (B, T, H), h_T (B, H), c_T (B, H)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import FwdCtx, Op
+from ..initializers import DefaultWeightInitializer, ZeroInitializer
+
+
+class LSTM(Op):
+    _type = "LSTM"
+
+    def __init__(self, model, input_tensor, hidden_size: int,
+                 hx=None, cx=None, share_with: Optional[Op] = None,
+                 name: Optional[str] = None):
+        inputs = [input_tensor]
+        if (hx is None) != (cx is None):
+            raise ValueError("provide both hx and cx or neither")
+        if hx is not None:
+            inputs += [hx, cx]
+        super().__init__(model, inputs, name)
+        b, t, e = input_tensor.dims
+        h = hidden_size
+        self.hidden_size = h
+        self.has_state_inputs = hx is not None
+        self._add_output((b, t, h), input_tensor.dtype)   # y
+        self._add_output((b, h), input_tensor.dtype)      # h_T
+        self._add_output((b, h), input_tensor.dtype)      # c_T
+        if share_with is not None:
+            if not isinstance(share_with, LSTM) or share_with.hidden_size != h:
+                raise ValueError("share_with must be an LSTM with the same hidden size")
+            self.share_from = share_with
+        else:
+            self._add_weight("w_ih", (e, 4 * h), DefaultWeightInitializer())
+            self._add_weight("w_hh", (h, 4 * h), DefaultWeightInitializer())
+            self._add_weight("bias", (4 * h,), ZeroInitializer())
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        x = xs[0]
+        b, t, _ = x.shape
+        h = self.hidden_size
+        dt = x.dtype
+        acc = jnp.float32 if dt == jnp.bfloat16 else None
+        w_ih = params["w_ih"].astype(dt)
+        w_hh = params["w_hh"].astype(dt)
+        bias = params["bias"].astype(jnp.float32)
+        if self.has_state_inputs:
+            h0, c0 = xs[1].astype(jnp.float32), xs[2].astype(jnp.float32)
+        else:
+            h0 = jnp.zeros((b, h), jnp.float32)
+            c0 = jnp.zeros((b, h), jnp.float32)
+
+        # One big input projection over all timesteps (B·T on the MXU rows).
+        xz = jnp.dot(x.reshape(b * t, -1), w_ih, preferred_element_type=acc)
+        xz = xz.reshape(b, t, 4 * h).astype(jnp.float32) + bias
+        xz = jnp.swapaxes(xz, 0, 1)  # (T, B, 4H) for scan
+
+        def step(carry, xz_t):
+            h_prev, c_prev = carry
+            z = xz_t + jnp.dot(h_prev.astype(dt), w_hh,
+                               preferred_element_type=acc).astype(jnp.float32)
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (h_t, c_t), ys = lax.scan(step, (h0, c0), xz)
+        y = jnp.swapaxes(ys, 0, 1).astype(dt)  # (B, T, H)
+        return [y, h_t.astype(dt), c_t.astype(dt)]
+
+    def flops_per_sample(self):
+        _, t, e = self.inputs[0].dims
+        h = self.hidden_size
+        return 2.0 * t * (e + h) * 4 * h

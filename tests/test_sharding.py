@@ -115,8 +115,15 @@ def test_import_export_strategy_file(devices, tmp_path):
     inp = m.create_tensor((16, 3, 12, 12))
     t = m.conv2d(inp, 8, 3, 3, 1, 1, 1, 1, name="conv1")
     t = m.flat(t, name="flat1")
-    t = m.dense(t, 10, name="fc1")
+    t = m.dense(t, 32, name="fc1")
     m.softmax(t, name="softmax1")
     m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy", ["accuracy"])
     assert m.ops[0].pc.dims == (2, 2, 2, 1)
     assert m.ops[2].pc.dims == (2, 4)
+    # a degree that does not divide the dim is legalized down (10 % 4 != 0)
+    m2 = ff.FFModel(ff.FFConfig(batch_size=16, import_strategy_file=path))
+    inp2 = m2.create_tensor((16, 48), nchw=False)
+    t2 = m2.dense(inp2, 10, name="fc1")
+    m2.softmax(t2, name="softmax1")
+    m2.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy", ["accuracy"])
+    assert m2.ops[0].pc.dims == (2, 2)
