@@ -97,6 +97,73 @@ class Op:
         measured timing is unavailable."""
         return 0.0
 
+    # -- tiling hooks (simulator comm model; analogue of the reference's
+    # get_output_tensor_shape / get_input_tensor_shape, model.cc:333-380) --
+    def _grid_coord(self, pc, part_idx):
+        coord = []
+        rem = part_idx
+        for d in reversed(pc.dims):
+            coord.append(rem % d)
+            rem //= d
+        return tuple(reversed(coord))
+
+    def output_tile(self, pc, part_idx, output_idx: int = 0):
+        """Per-dim (lo, hi) inclusive ranges of this part's output tile."""
+        dims = self.outputs[output_idx].dims
+        coord = self._grid_coord(pc, part_idx)
+        out = []
+        for i, size in enumerate(dims):
+            deg = pc.dims[i] if i < len(pc.dims) else 1
+            c = coord[i] if i < len(coord) else 0
+            tile = size // deg
+            out.append((c * tile, (c + 1) * tile - 1))
+        return out
+
+    def input_ranges(self, j: int, pc, part_idx):
+        """Per-dim (lo, hi) ranges of input ``j`` this part reads.
+
+        Default: proportional mapping when ranks match (a dim of the
+        output maps onto the same dim of the input, scaled — this yields
+        conv-style halos approximately); otherwise only the batch dim is
+        tiled and the rest is read fully."""
+        in_dims = self.inputs[j].dims
+        out_dims = self.outputs[0].dims
+        tile = self.output_tile(pc, part_idx)
+        rng = []
+        if len(in_dims) == len(out_dims):
+            for i, isz in enumerate(in_dims):
+                osz = out_dims[i]
+                lo, hi = tile[i]
+                if isz == osz:
+                    rng.append((lo, hi))
+                else:
+                    rng.append((lo * isz // osz,
+                                min(isz - 1, -((-(hi + 1) * isz) // osz) - 1)))
+        else:
+            b_lo, b_hi = tile[0]
+            rng.append((b_lo * in_dims[0] // out_dims[0],
+                        (b_hi + 1) * in_dims[0] // out_dims[0] - 1))
+            for isz in in_dims[1:]:
+                rng.append((0, isz - 1))
+        return rng
+
+    def weight_tile(self, pc, w_idx: int, part_idx):
+        """Per-dim ranges of weight ``w_idx`` held by this part — full
+        range for replicated dims, the part's slice for sharded dims."""
+        w = self.weights[w_idx]
+        coord = self._grid_coord(pc, part_idx)
+        out = []
+        for i, size in enumerate(w.dims):
+            pd = w.partition_dims[i]
+            if pd is None or pd >= len(pc.dims) or pc.dims[pd] == 1:
+                out.append((0, size - 1))
+            else:
+                deg = pc.dims[pd]
+                c = coord[pd]
+                tile = size // deg
+                out.append((c * tile, (c + 1) * tile - 1))
+        return out
+
     def __repr__(self):
         ins = ",".join(str(t.dims) for t in self.inputs)
         outs = ",".join(str(t.dims) for t in self.outputs)

@@ -62,3 +62,11 @@ class Linear(Op):
         in_dim = self.inputs[0].dims[-1]
         out_dim = self.output.dims[-1]
         return 2.0 * in_dim * out_dim
+
+    def input_ranges(self, j, pc, part_idx):
+        """Every out-channel shard reads the FULL input feature dim (the
+        reference replicates the input per c-shard, linear.cu:174-185)."""
+        rng = super().input_ranges(j, pc, part_idx)
+        in_dims = self.inputs[0].dims
+        rng[-1] = (0, in_dims[-1] - 1)
+        return rng

@@ -93,3 +93,9 @@ class LSTM(Op):
         _, t, e = self.inputs[0].dims
         h = self.hidden_size
         return 2.0 * t * (e + h) * 4 * h
+
+    def input_ranges(self, j, pc, part_idx):
+        """Batch-tiled only: the recurrence needs the full time extent."""
+        in_dims = self.inputs[j].dims
+        b_lo, b_hi = self.output_tile(pc, part_idx)[0]
+        return [(b_lo, b_hi)] + [(0, s - 1) for s in in_dims[1:]]

@@ -78,6 +78,20 @@ class Concat(Op):
     def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
         return [jnp.concatenate(xs, axis=self.axis)]
 
+    def input_ranges(self, j, pc, part_idx):
+        """Output tile ranges shifted by the input's offset along the
+        concat axis, clipped to that input's extent."""
+        tile = self.output_tile(pc, part_idx)
+        off = sum(t.dims[self.axis] for t in self.inputs[:j])
+        in_dims = self.inputs[j].dims
+        rng = []
+        for i, (lo, hi) in enumerate(tile):
+            if i == self.axis:
+                lo, hi = lo - off, hi - off
+                lo, hi = max(0, lo), min(in_dims[i] - 1, hi)
+            rng.append((lo, hi))
+        return rng
+
 
 class Dropout(Op):
     """Reference: src/ops/dropout.cu (cudnnDropout, seeded reserve space).

@@ -1,0 +1,144 @@
+"""Per-op compute-cost model: measured on the real chip, cached, with a
+roofline fallback.
+
+TPU analogue of the reference's ``measure_compute_time`` machinery
+(reference: Op::measure_compute_time per op, e.g. conv_2d.cu:937-1039,
+cached by (op, config) hash in simulator.cc:235-273).  On TPU a compile
+costs seconds, not microseconds, so caching is mandatory: measurements key
+on (op type, sub-tensor shape signature) and persist to disk
+(.simcache.json) across processes — the analogue of the reference's
+in-memory hash_to_op_{forward,backward}_time maps, made durable.
+
+When no accelerator is available (or measure=False) the cost comes from a
+roofline: time = max(flops / (peak·eff), bytes / hbm_bw) + launch overhead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .machine import TPUMachineModel
+
+
+class CostModel:
+    def __init__(self, machine: TPUMachineModel, measure: bool = False,
+                 cache_path: str = ".simcache.json"):
+        self.machine = machine
+        self.measure = measure
+        self.cache_path = cache_path
+        self._cache: Dict[str, float] = {}
+        if cache_path and os.path.exists(cache_path):
+            try:
+                with open(cache_path) as f:
+                    self._cache = json.load(f)
+            except Exception:
+                self._cache = {}
+
+    def _persist(self):
+        if self.cache_path:
+            try:
+                with open(self.cache_path, "w") as f:
+                    json.dump(self._cache, f)
+            except OSError:
+                pass
+
+    # -- shape bookkeeping -------------------------------------------------
+    @staticmethod
+    def _sub_output_shape(op, pc) -> Tuple[int, ...]:
+        dims = op.outputs[0].dims
+        return tuple(sz // (pc.dims[i] if i < len(pc.dims) else 1)
+                     for i, sz in enumerate(dims))
+
+    @staticmethod
+    def _key(op, sub_shape, which: str) -> str:
+        extra = ""
+        if hasattr(op, "kernel"):
+            extra = f"k{op.kernel}s{op.stride}"
+        if hasattr(op, "hidden_size"):
+            extra = f"h{op.hidden_size}"
+        return f"{op._type}:{sub_shape}:{extra}:{which}"
+
+    # -- analytic roofline -------------------------------------------------
+    def _analytic(self, op, pc, which: str) -> float:
+        m = self.machine
+        sub = self._sub_output_shape(op, pc)
+        sub_batch = sub[0]
+        scale = np.prod(sub) / max(1, np.prod(op.outputs[0].dims))
+        flops = op.flops_per_sample() * op.outputs[0].dims[0] * scale
+        # bytes: inputs read + outputs written for this part (activations)
+        in_vol = sum(int(np.prod([hi - lo + 1 for lo, hi in op.input_ranges(j, pc, 0)]))
+                     for j in range(len(op.inputs)))
+        w_vol = sum(w.volume() for w in op.weights)
+        out_vol = int(np.prod(sub))
+        bytes_moved = 4.0 * (in_vol + w_vol + out_vol)
+        t = max(flops / (m.peak_flops * m.mxu_efficiency),
+                bytes_moved / m.hbm_bandwidth) + m.kernel_launch_overhead
+        if which == "backward":
+            t *= 2.0  # dgrad + wgrad ≈ 2× forward (reference measures both)
+        return float(t)
+
+    # -- real measurement --------------------------------------------------
+    def _measure_real(self, op, pc, which: str) -> Optional[float]:
+        """Compile+time the op's forward (and backward via jax.grad) on the
+        per-part sub-shape, on the default accelerator."""
+        try:
+            import jax
+            import jax.numpy as jnp
+            from ..ops.base import FwdCtx
+
+            sub_out = self._sub_output_shape(op, pc)
+            sub_ins = []
+            for j, t in enumerate(op.inputs):
+                rng = op.input_ranges(j, pc, 0)
+                sub_ins.append(tuple(hi - lo + 1 for lo, hi in rng))
+            import time as _t
+
+            key = jax.random.key(0)
+            xs = [jnp.zeros(s, jnp.int32 if "int" in op.inputs[j].dtype
+                            else jnp.float32)
+                  for j, s in enumerate(sub_ins)]
+            owner = op.share_from if op.share_from is not None else op
+            params = {w.name: jnp.zeros(w.dims, jnp.float32) for w in owner.weights}
+            ctx = FwdCtx(training=False, rng=key,
+                         stats_in={op.name: op.init_stats()} if op.init_stats() else {})
+
+            def fwd(params, xs):
+                return op.forward(params, list(xs), ctx)[0]
+
+            if which == "forward":
+                fn = jax.jit(fwd)
+                sync = lambda r: jax.device_get(jnp.sum(r.astype(jnp.float32)))
+            else:
+                def loss(params, xs):
+                    return jnp.sum(fwd(params, xs).astype(jnp.float32))
+
+                fn = jax.jit(jax.value_and_grad(loss))
+                sync = lambda r: jax.device_get(r[0])
+            sync(fn(params, xs))  # compile + warmup
+            n = 5
+            t0 = _t.perf_counter()
+            for _ in range(n - 1):
+                fn(params, xs)
+            sync(fn(params, xs))
+            return (_t.perf_counter() - t0) / n
+        except Exception:
+            return None
+
+    # -- public ------------------------------------------------------------
+    def op_time(self, op, pc, which: str) -> float:
+        sub = self._sub_output_shape(op, pc)
+        key = self._key(op, sub, which)
+        if key in self._cache:
+            return self._cache[key]
+        t = None
+        if self.measure:
+            t = self._measure_real(op, pc, which)
+        if t is None:
+            t = self._analytic(op, pc, which)
+        self._cache[key] = t
+        self._persist()
+        return t

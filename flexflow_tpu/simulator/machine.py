@@ -1,0 +1,79 @@
+"""TPU machine model for the execution simulator.
+
+TPU-native analogue of the reference device/bandwidth graph
+(reference: src/runtime/simulator.cu:21-74 — per-GPU compute devices plus
+COMM devices with three hardcoded bandwidths: intra-node ~20 GB/s,
+inter-node 12/numNodes, gpu↔dram 16).
+
+The TPU model replaces those constants with a 2-D ICI torus: each chip has
+a (x, y) coordinate; transfer cost between chips scales with Manhattan
+hop distance on the torus (wraparound links), using per-link ICI bandwidth.
+Multi-host slices add a DCN tier: chips on different hosts pay the DCN
+bandwidth instead.  Numbers default to TPU v5e
+(peak 197 TFLOP/s bf16, HBM 819 GB/s, ICI ~45 GB/s/link/direction,
+DCN ~25 GB/s/host) and are all overridable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Tuple
+
+
+@dataclasses.dataclass
+class TPUMachineModel:
+    num_devices: int = 8
+    chips_per_host: int = 8
+    peak_flops: float = 197e12        # bf16 MXU
+    hbm_bandwidth: float = 819e9      # bytes/s
+    ici_bandwidth: float = 45e9       # bytes/s per link per direction
+    dcn_bandwidth: float = 25e9       # bytes/s per host
+    kernel_launch_overhead: float = 2e-6   # s; XLA per-fused-region overhead
+    mxu_efficiency: float = 0.45      # achievable fraction of peak for convs/matmuls
+
+    def __post_init__(self):
+        # near-square 2-D torus layout, the v5e topology family
+        # (e.g. 16 chips → 4x4, 8 → 4x2)
+        n = self.num_devices
+        x = int(math.sqrt(n))
+        while x > 1 and n % x != 0:
+            x -= 1
+        self.torus = (max(1, x), n // max(1, x))
+
+    def coord(self, dev: int) -> Tuple[int, int]:
+        return (dev % self.torus[0], dev // self.torus[0])
+
+    def hops(self, a: int, b: int) -> int:
+        """Manhattan distance on the wraparound torus."""
+        if a == b:
+            return 0
+        (ax, ay), (bx, by) = self.coord(a), self.coord(b)
+        dx = abs(ax - bx)
+        dy = abs(ay - by)
+        dx = min(dx, self.torus[0] - dx)
+        dy = min(dy, self.torus[1] - dy)
+        return dx + dy
+
+    def same_host(self, a: int, b: int) -> bool:
+        return a // self.chips_per_host == b // self.chips_per_host
+
+    def transfer_time(self, a: int, b: int, num_bytes: float) -> float:
+        """Point-to-point transfer cost in seconds."""
+        if a == b or num_bytes <= 0:
+            return 0.0
+        if self.same_host(a, b):
+            return num_bytes * max(1, self.hops(a, b)) / self.ici_bandwidth
+        return num_bytes / self.dcn_bandwidth
+
+    def allreduce_time(self, devices, num_bytes: float) -> float:
+        """Ring allreduce over ICI: 2·(n-1)/n · bytes / link_bw (the cost
+        of the psum XLA emits for gradient sync — replaces the reference's
+        replica-gather model, optimizer_kernel.cu:168-180)."""
+        n = len(set(devices))
+        if n <= 1 or num_bytes <= 0:
+            return 0.0
+        bw = self.ici_bandwidth
+        if not all(self.same_host(devices[0], d) for d in devices):
+            bw = self.dcn_bandwidth
+        return 2.0 * (n - 1) / n * num_bytes / bw

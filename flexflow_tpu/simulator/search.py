@@ -1,10 +1,120 @@
-"""MCMC strategy search entry point (placeholder until the simulator
-milestone lands — see simulator/ package docstring)."""
+"""MCMC (simulated annealing) strategy search.
+
+TPU-native analogue of ``FFModel::optimize`` / ``rewrite``
+(reference: src/runtime/model.cc:1046-1107) with identical accept
+semantics: start from data parallelism; each iteration rewrites one random
+op to a random legal config; accept when faster, else with probability
+``exp(-alpha * (next - current))``; track the best ever seen.
+
+The proposal distribution is TPU-shaped: candidate configs are random
+factorizations of a divisor of the device count over the op's partitionable
+dims (the reference's base class proposes batch-only splits,
+model.cc:305-334; the richer SOAP space there comes from strategy files —
+here the search itself explores it, restricted per op type the way the
+reference ops restrict their Legion task grids, e.g. softmax asserts no
+channel split, softmax.cu).
+"""
 
 from __future__ import annotations
 
+import math
+import random
+from typing import Dict, List, Optional
 
-def mcmc_search(model, budget: int, alpha: float):
-    raise NotImplementedError(
-        "strategy search requires the execution simulator; "
-        "it is being built — run without --budget for now")
+from ..config import ParallelConfig
+from .cost_model import CostModel
+from .machine import TPUMachineModel
+from .simulator import Simulator
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+# Per-op-type partitionable dims (natural order, batch first / NHWC).
+# Mirrors which Legion task-grid dims each reference op actually splits.
+_SPLITTABLE = {
+    "Conv2D": (0, 1, 2),       # n, h, w (reference asserts c unsplit, conv_2d.cu:203)
+    "Pool2D": (0, 1, 2),
+    "Dense": (0, 1),           # n, c_out (linear.cu tensor parallelism)
+    "Embedding": (0, 1),       # n, out_dim
+    "Concat": (0,),
+    "Flat": (0,),
+    "Softmax": (0,),           # sample only (softmax.cu asserts)
+    "BatchNorm": (0,),
+    "Dropout": (0,),
+    "ElementUnary": (0,),
+    "ElementBinary": (0,),
+    "LSTM": (0,),              # batch only: recurrence over T
+    "MSELoss": (0,),
+}
+
+
+def random_parallel_config(op, num_devices: int, rng: random.Random) -> ParallelConfig:
+    """Random legal SOAP config for ``op`` over ``num_devices`` chips."""
+    rank = op.output.num_dims
+    splittable = _SPLITTABLE.get(op._type, (0,))
+    num_parts = rng.choice(_divisors(num_devices))
+    # randomly factor num_parts across splittable dims
+    degrees = [1] * rank
+    remaining = num_parts
+    dims_order = list(splittable)
+    rng.shuffle(dims_order)
+    for d in dims_order:
+        if remaining == 1:
+            break
+        opts = [f for f in _divisors(remaining)
+                if d < rank and op.output.dims[d] % (degrees[d] * f) == 0]
+        f = rng.choice(opts) if opts else 1
+        degrees[d] *= f
+        remaining //= f
+    if remaining > 1:  # couldn't place everything: dump the rest on batch
+        if op.output.dims[0] % (degrees[0] * remaining) == 0:
+            degrees[0] *= remaining
+        # else: leave fewer parts — still legal
+    pc = ParallelConfig(dims=tuple(degrees))
+    n = pc.num_parts()
+    start = rng.randrange(0, num_devices - n + 1) if num_devices > n else 0
+    return pc.with_device_ids(tuple(range(start, start + n)))
+
+
+def mcmc_search(model, budget: int, alpha: float = 0.05,
+                machine_model: Optional[TPUMachineModel] = None,
+                measure: bool = False, seed: int = 0,
+                overlap_backward_update: Optional[bool] = None,
+                verbose: bool = True) -> Dict[str, ParallelConfig]:
+    """Returns the best strategy map found (op name → ParallelConfig)."""
+    nd = model.machine.num_devices if model.machine is not None \
+        else model.config.num_devices
+    mm = machine_model or TPUMachineModel(num_devices=nd)
+    overlap = model.config.search_overlap_backward_update \
+        if overlap_backward_update is None else overlap_backward_update
+    sim = Simulator(mm, CostModel(mm, measure=measure),
+                    overlap_backward_update=overlap)
+    rng = random.Random(seed)
+
+    current = {op.name: ParallelConfig.data_parallel(op.output.num_dims, nd)
+               .with_device_ids(tuple(range(nd)))
+               for op in model.ops}
+    current_rt = sim.simulate_runtime(model, current)
+    best, best_rt = dict(current), current_rt
+
+    for it in range(budget):
+        op = rng.choice(model.ops)
+        nxt = dict(current)
+        nxt[op.name] = random_parallel_config(op, nd, rng)
+        nxt_rt = sim.simulate_runtime(model, nxt)
+        if verbose and it % 100 == 0:
+            print(f"iter({it}) cur({current_rt * 1e3:.3f}ms) "
+                  f"next({nxt_rt * 1e3:.3f}ms) best({best_rt * 1e3:.3f}ms)")
+        if nxt_rt < best_rt:
+            best_rt, best = nxt_rt, dict(nxt)
+        if nxt_rt < current_rt or rng.random() < math.exp(
+                -alpha * (nxt_rt - current_rt) * 1e3):
+            current, current_rt = nxt, nxt_rt
+    if verbose:
+        print("=========== Best Discovered Strategy ==========")
+        for name, pc in best.items():
+            print(f"[{name}] dims{list(pc.dims)} parts({pc.num_parts()})")
+        print(f"simulated runtime: {best_rt * 1e3:.3f} ms/iter")
+    return best

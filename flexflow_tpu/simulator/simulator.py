@@ -1,0 +1,204 @@
+"""Task-graph execution simulator.
+
+TPU-native analogue of ``Simulator::simulate_runtime``
+(reference: src/runtime/simulator.cc:275-448).  Semantics preserved:
+
+  1. one forward + one backward task per (op, part), with measured or
+     roofline compute times;
+  2. comm tasks inserted where a consumer part's input rectangle
+     intersects a producer part's output rectangle on another chip
+     (the analogue of Legion's implicit copies), costed by the ICI-torus
+     machine model;
+  3. weight synchronization per the bulk-synchronous model
+     (simulator.cc:361-408): per-device barrier after backward, then one
+     update task per distinct weight replica group — costed as the ring
+     allreduce XLA would emit — or the overlapped mode where update tasks
+     depend only on their own backward tasks;
+  4. event-driven simulation with a ready queue and per-device/per-link
+     timelines.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import ParallelConfig
+from .cost_model import CostModel
+from .machine import TPUMachineModel
+
+
+class _Task:
+    __slots__ = ("name", "device", "run_time", "next", "counter", "ready_time", "order")
+    _order = itertools.count()
+
+    def __init__(self, name: str, device, run_time: float):
+        self.name = name
+        self.device = device          # ("chip", id) | ("link", a, b) | None
+        self.run_time = run_time
+        self.next: List["_Task"] = []
+        self.counter = 0
+        self.ready_time = 0.0
+        self.order = next(_Task._order)
+
+    def add_next(self, t: "_Task"):
+        self.next.append(t)
+        t.counter += 1
+
+
+def _intersect(ra, rb) -> int:
+    vol = 1
+    for (alo, ahi), (blo, bhi) in zip(ra, rb):
+        lo, hi = max(alo, blo), min(ahi, bhi)
+        if hi < lo:
+            return 0
+        vol *= hi - lo + 1
+    return vol
+
+
+class Simulator:
+    def __init__(self, machine: Optional[TPUMachineModel] = None,
+                 cost_model: Optional[CostModel] = None,
+                 overlap_backward_update: bool = False):
+        self.machine = machine or TPUMachineModel()
+        self.cost = cost_model or CostModel(self.machine)
+        self.overlap = overlap_backward_update
+
+    def _devices_of(self, pc: ParallelConfig) -> List[int]:
+        n = pc.num_parts()
+        ids = list(pc.device_ids[:n])
+        if len(ids) < n:
+            ids = list(range(n))
+        return [d % self.machine.num_devices for d in ids]
+
+    def simulate_runtime(self, model, strategies: Dict[str, ParallelConfig]) -> float:
+        """Simulated seconds per training iteration under ``strategies``
+        (keyed by op name; missing ops fall back to their compiled pc or
+        data parallelism)."""
+        ops = model.ops
+        nd = self.machine.num_devices
+        tasks: List[_Task] = []
+        fwd: Dict[Tuple[int, int], _Task] = {}
+        bwd: Dict[Tuple[int, int], _Task] = {}
+
+        def pc_of(op) -> ParallelConfig:
+            pc = strategies.get(op.name) or getattr(
+                op, "pc", None) or ParallelConfig.data_parallel(op.output.num_dims, nd)
+            return model._legalize_pc(op, pc) if hasattr(model, "_legalize_pc") else pc
+
+        # Step 1: compute tasks
+        for li, op in enumerate(ops):
+            pc = pc_of(op)
+            devs = self._devices_of(pc)
+            ft = self.cost.op_time(op, pc, "forward")
+            bt = self.cost.op_time(op, pc, "backward")
+            for j in range(pc.num_parts()):
+                t1 = _Task(f"fwd:{op.name}:{j}", ("chip", devs[j]), ft)
+                t2 = _Task(f"bwd:{op.name}:{j}", ("chip", devs[j]), bt)
+                t1.add_next(t2)
+                fwd[(li, j)] = t1
+                bwd[(li, j)] = t2
+                tasks += [t1, t2]
+
+        def add_xfer(src: _Task, dst: _Task, volume: int):
+            if volume <= 0:
+                return
+            a = src.device[1] if src.device else 0
+            b = dst.device[1] if dst.device else 0
+            if a == b:
+                src.add_next(dst)
+                return
+            tt = self.machine.transfer_time(a, b, 4.0 * volume)
+            comm = _Task(f"comm:{src.name}->{dst.name}",
+                         ("link", min(a, b), max(a, b)), tt)
+            src.add_next(comm)
+            comm.add_next(dst)
+            tasks.append(comm)
+
+        # Step 2: data dependencies + comm tasks
+        op_index = {id(op): i for i, op in enumerate(ops)}
+        for li, op in enumerate(ops):
+            pc = pc_of(op)
+            for j, tin in enumerate(op.inputs):
+                pre = tin.owner_op
+                if pre is None or id(pre) not in op_index:
+                    continue
+                pi = op_index[id(pre)]
+                pre_pc = pc_of(pre)
+                for dst_id in range(pc.num_parts()):
+                    dst_r = op.input_ranges(j, pc, dst_id)
+                    for src_id in range(pre_pc.num_parts()):
+                        src_r = pre.output_tile(pre_pc, src_id, tin.owner_idx)
+                        vol = _intersect(dst_r, src_r)
+                        if vol > 0:
+                            add_xfer(fwd[(pi, src_id)], fwd[(li, dst_id)], vol)
+                            add_xfer(bwd[(li, dst_id)], bwd[(pi, src_id)], vol)
+
+        # Step 3: weight synchronization
+        if self.overlap:
+            barriers = None
+        else:
+            barriers = [_Task(f"barrier:{d}", ("chip", d), 0.0) for d in range(nd)]
+            tasks += barriers
+            for li, op in enumerate(ops):
+                pc = pc_of(op)
+                devs = self._devices_of(pc)
+                for j in range(pc.num_parts()):
+                    bwd[(li, j)].add_next(barriers[devs[j]])
+
+        for li, op in enumerate(ops):
+            if not op.weights:
+                continue
+            pc = pc_of(op)
+            devs = self._devices_of(pc)
+            for wi, w in enumerate(op.weights):
+                synched = set()
+                for first in range(pc.num_parts()):
+                    if first in synched:
+                        continue
+                    synched.add(first)
+                    first_r = op.weight_tile(pc, wi, first)
+                    group = [first]
+                    for nxt in range(first + 1, pc.num_parts()):
+                        if nxt in synched:
+                            continue
+                        if _intersect(first_r, op.weight_tile(pc, wi, nxt)) > 0:
+                            synched.add(nxt)
+                            group.append(nxt)
+                    vol = int(np.prod([hi - lo + 1 for lo, hi in first_r]))
+                    gdevs = [devs[g] for g in group]
+                    # psum over the replica group: ring allreduce cost
+                    upd = _Task(f"upd:{op.name}:{w.name}:{first}",
+                                ("chip", devs[first]),
+                                self.machine.allreduce_time(gdevs, 4.0 * vol))
+                    tasks.append(upd)
+                    if barriers is not None:
+                        for d in set(gdevs):
+                            barriers[d].add_next(upd)
+                    else:
+                        for g in group:
+                            bwd[(li, g)].add_next(upd)
+
+        # Steps 4-5: event-driven simulation
+        ready = [(0.0, t.order, t) for t in tasks if t.counter == 0]
+        heapq.heapify(ready)
+        device_time: Dict[Tuple, float] = {}
+        sim_time = 0.0
+        processed = 0
+        while ready:
+            _, _, t = heapq.heappop(ready)
+            start = max(device_time.get(t.device, 0.0), t.ready_time)
+            end = start + t.run_time
+            device_time[t.device] = end
+            sim_time = max(sim_time, end)
+            processed += 1
+            for nt in t.next:
+                nt.ready_time = max(nt.ready_time, end)
+                nt.counter -= 1
+                if nt.counter == 0:
+                    heapq.heappush(ready, (nt.ready_time, nt.order, nt))
+        assert processed == len(tasks), "cycle in simulated task graph"
+        return sim_time
