@@ -1,0 +1,10 @@
+"""Keras-like frontend (reference: python/flexflow/keras/, 3894 LoC)."""
+
+from . import callbacks, layers, optimizers
+from .callbacks import (Callback, EpochVerifyMetrics, LearningRateScheduler,
+                        VerifyMetrics)
+from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
+                     Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
+                     Input, MaxPooling2D, Multiply, Subtract)
+from .models import Model, Sequential
+from .optimizers import SGD, Adam

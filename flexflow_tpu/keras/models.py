@@ -1,0 +1,206 @@
+"""Keras-like Sequential and functional Model.
+
+Mirrors the reference Keras frontend (reference:
+python/flexflow/keras/models/{base_model,sequential,model}.py):
+``compile()`` translates layers/optimizer/loss/metric names onto the core
+FFModel (base_model.py:129-191 analogue); ``fit()`` builds dataloaders and
+drives the fused train loop with per-epoch metric printing and callbacks
+(base_model.py:367-431 analogue — the Legion tracing there is XLA
+compilation caching here).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import losses as core_losses
+from ..config import FFConfig
+from ..metrics import MetricsType
+from ..model import FFModel
+from ..runtime.dataloader import DataLoader
+from .layers import KTensor, Layer
+from .optimizers import Optimizer as KOptimizer, SGD
+
+_LOSS_NAMES = {
+    "categorical_crossentropy": "categorical_crossentropy",
+    "sparse_categorical_crossentropy": "sparse_categorical_crossentropy",
+    "mean_squared_error": "mean_squared_error",
+    "mse": "mean_squared_error",
+}
+_METRIC_NAMES = {
+    "accuracy": MetricsType.ACCURACY,
+    "categorical_crossentropy": MetricsType.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy": MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
+    "root_mean_squared_error": MetricsType.ROOT_MEAN_SQUARED_ERROR,
+    "mean_absolute_error": MetricsType.MEAN_ABSOLUTE_ERROR,
+}
+
+
+class BaseModel:
+    def __init__(self, name: str = "model", config: Optional[FFConfig] = None):
+        self.name = name
+        self._ffconfig = config or FFConfig()
+        self._ffmodel: Optional[FFModel] = None
+        self._optimizer: Optional[KOptimizer] = None
+        self._loss: Optional[str] = None
+        self._metric_names: List[str] = []
+        self._inputs: List[KTensor] = []
+        self._output: Optional[KTensor] = None
+        self._core_inputs = []  # core Tensors, parallel to _inputs
+
+    # -- graph lowering ----------------------------------------------------
+    def _lower(self):
+        ff = FFModel(self._ffconfig)
+        b = self._ffconfig.batch_size
+        mapping: Dict[int, object] = {}
+        for kt in self._inputs:
+            dims = (b,) + kt.shape
+            nchw = len(dims) == 4
+            core = ff.create_tensor(dims, dtype=kt.dtype, nchw=nchw)
+            mapping[id(kt)] = core
+            self._core_inputs.append(core)
+
+        def visit(kt: KTensor):
+            if id(kt) in mapping:
+                return mapping[id(kt)]
+            core_ins = [visit(i) for i in kt.inputs]
+            out = kt.layer.lower(ff, core_ins)
+            mapping[id(kt)] = out
+            return out
+
+        visit(self._output)
+        self._ffmodel = ff
+        return ff
+
+    # -- keras API ---------------------------------------------------------
+    def compile(self, optimizer: Union[KOptimizer, str],
+                loss: str, metrics: Sequence[str]):
+        if isinstance(optimizer, str):
+            optimizer = SGD()
+        self._optimizer = optimizer
+        self._loss = _LOSS_NAMES[loss]
+        self._metric_names = [m for m in metrics]
+        core_metrics = [_METRIC_NAMES[m] for m in metrics]
+        ff = self._lower()
+        ff.compile(optimizer.to_core(), self._loss, core_metrics)
+        ff.init_layers()
+
+    @property
+    def ffmodel(self) -> FFModel:
+        return self._ffmodel
+
+    def fit(self, x, y, epochs: int = 1, callbacks: Sequence = (),
+            batch_size: Optional[int] = None, verbose: bool = True):
+        ff = self._ffmodel
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        inputs = {t: np.asarray(a) for t, a in zip(self._core_inputs, xs)}
+        y = np.asarray(y)
+        if self._loss == "sparse_categorical_crossentropy" and y.ndim == 1:
+            y = y[:, None]
+        dl = DataLoader(ff, inputs, y)
+        for cb in callbacks:
+            cb.set_model(self)
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            dl.reset()
+            ff.reset_metrics()
+            ff.optimizer.next_epoch()
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            for _ in range(dl.num_batches()):
+                dl.next_batch(ff)
+                ff.train_iteration()
+            pm = ff.get_metrics()
+            logs = self._logs_from(pm)
+            if verbose:
+                print(f"epoch {epoch}: {pm.to_string()}")
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+        for cb in callbacks:
+            cb.on_train_end()
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None) -> Dict[str, float]:
+        ff = self._ffmodel
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        inputs = {t: np.asarray(a) for t, a in zip(self._core_inputs, xs)}
+        y = np.asarray(y)
+        if self._loss == "sparse_categorical_crossentropy" and y.ndim == 1:
+            y = y[:, None]
+        dl = DataLoader(ff, inputs, y)
+        from ..metrics import PerfMetrics
+
+        total = PerfMetrics()
+        for _ in range(dl.num_batches()):
+            dl.next_batch(ff)
+            one = ff.eval_batch()
+            total.update({k: v for k, v in one.items() if k != "loss"})
+        return self._logs_from(total)
+
+    def _logs_from(self, pm) -> Dict[str, float]:
+        n = max(1, pm.train_all)
+        return {
+            "accuracy": pm.accuracy / 100.0,
+            "categorical_crossentropy": pm.cce_loss / n,
+            "sparse_categorical_crossentropy": pm.sparse_cce_loss / n,
+            "mean_squared_error": pm.mse_loss / n,
+            "root_mean_squared_error": pm.rmse_loss / n,
+            "mean_absolute_error": pm.mae_loss / n,
+        }
+
+    def summary(self) -> str:
+        lines = [f'Model: "{self.name}"']
+        if self._ffmodel is not None:
+            for op in self._ffmodel.ops:
+                nparam = sum(w.volume() for w in op.weights)
+                lines.append(f"  {op.name:30s} {op._type:14s} "
+                             f"out={op.output.dims} params={nparam}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+class Model(BaseModel):
+    """Functional model (reference: keras/models/model.py)."""
+
+    def __init__(self, inputs, outputs, name: str = "model",
+                 config: Optional[FFConfig] = None):
+        super().__init__(name, config)
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._inputs = list(self._inputs)
+        self._output = outputs
+
+
+class Sequential(BaseModel):
+    """Sequential model (reference: keras/models/sequential.py)."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None,
+                 name: str = "sequential", config: Optional[FFConfig] = None):
+        super().__init__(name, config)
+        self._layer_list: List[Layer] = []
+        self._pending_input: Optional[KTensor] = None
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer_or_input):
+        if isinstance(layer_or_input, KTensor):
+            self._pending_input = layer_or_input
+            return
+        self._layer_list.append(layer_or_input)
+
+    def _build_graph(self, input_shape=None):
+        from .layers import Input
+
+        if self._pending_input is None:
+            raise ValueError("Sequential needs an Input() added first")
+        t = self._pending_input
+        self._inputs = [t]
+        for l in self._layer_list:
+            t = l(t)
+        self._output = t
+
+    def compile(self, optimizer, loss, metrics):
+        self._build_graph()
+        super().compile(optimizer, loss, metrics)

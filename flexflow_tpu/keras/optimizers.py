@@ -1,0 +1,53 @@
+"""Keras-like optimizer wrappers (reference: python/flexflow/keras/optimizers.py)."""
+
+from __future__ import annotations
+
+from ..optimizers import AdamOptimizer, SGDOptimizer
+
+
+class Optimizer:
+    def to_core(self):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate: float = 0.01, lr: float = None,
+                 momentum: float = 0.0, nesterov: bool = False, decay: float = 0.0):
+        self.learning_rate = lr if lr is not None else learning_rate
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.decay = decay
+        self._core = None
+
+    def to_core(self):
+        self._core = SGDOptimizer(lr=self.learning_rate, momentum=self.momentum,
+                                  nesterov=self.nesterov, weight_decay=self.decay)
+        return self._core
+
+    def set_learning_rate(self, lr: float):
+        self.learning_rate = lr
+        if self._core is not None:
+            self._core.lr = lr
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate: float = 0.001, lr: float = None,
+                 beta_1: float = 0.9, beta_2: float = 0.999, epsilon: float = 1e-8,
+                 decay: float = 0.0):
+        self.learning_rate = lr if lr is not None else learning_rate
+        self.beta_1 = beta_1
+        self.beta_2 = beta_2
+        self.epsilon = epsilon
+        self.decay = decay
+        self._core = None
+
+    def to_core(self):
+        self._core = AdamOptimizer(alpha=self.learning_rate, beta1=self.beta_1,
+                                   beta2=self.beta_2, epsilon=self.epsilon,
+                                   weight_decay=self.decay)
+        return self._core
+
+    def set_learning_rate(self, lr: float):
+        self.learning_rate = lr
+        if self._core is not None:
+            self._core.alpha = lr

@@ -88,5 +88,7 @@ class DataLoader:
             self.next_index = 0
         sel = self._order[self.next_index:self.next_index + b]
         self.next_index += b
-        ff.set_batch({t: a[sel] for t, a in self.inputs.items()},
-                     self.labels[sel])
+        from ..utils.native import gather_rows
+
+        ff.set_batch({t: gather_rows(a, sel) for t, a in self.inputs.items()},
+                     gather_rows(self.labels, sel))

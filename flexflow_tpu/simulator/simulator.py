@@ -182,7 +182,11 @@ class Simulator:
                         for g in group:
                             bwd[(li, g)].add_next(upd)
 
-        # Steps 4-5: event-driven simulation
+        # Steps 4-5: event-driven simulation — native C++ engine when built
+        # (native/ffsim.cpp), Python fallback otherwise.
+        native = self._simulate_native(tasks)
+        if native is not None:
+            return native
         ready = [(0.0, t.order, t) for t in tasks if t.counter == 0]
         heapq.heapify(ready)
         device_time: Dict[Tuple, float] = {}
@@ -202,3 +206,25 @@ class Simulator:
                     heapq.heappush(ready, (nt.ready_time, nt.order, nt))
         assert processed == len(tasks), "cycle in simulated task graph"
         return sim_time
+
+    def _simulate_native(self, tasks: List[_Task]) -> Optional[float]:
+        from ..utils.native import simulate_dag
+
+        nd = self.machine.num_devices
+        index = {id(t): i for i, t in enumerate(tasks)}
+        run_times = [t.run_time for t in tasks]
+
+        def key(dev) -> int:
+            if dev is None:
+                return 1 << 40
+            if dev[0] == "chip":
+                return dev[1]
+            return -(dev[1] * nd + dev[2] + 1)  # link (a, b)
+
+        devices = [key(t.device) for t in tasks]
+        src, dst = [], []
+        for t in tasks:
+            for nt in t.next:
+                src.append(index[id(t)])
+                dst.append(index[id(nt)])
+        return simulate_dag(run_times, devices, src, dst)

@@ -1,0 +1,4 @@
+"""Torch-like frontend (reference: python/flexflow/torch/nn/)."""
+
+from .nn import (Conv2d, Flatten, Linear, MaxPool2d, Module, ReLU, Sigmoid,
+                 Softmax, Tanh)

@@ -1,0 +1,103 @@
+"""ctypes bindings for the native (C++) runtime components under native/.
+
+Loads lazily; every native path has a pure-Python fallback, so missing
+.so files degrade gracefully (and `make -C native` builds them).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+_libs = {}
+
+
+def _load(name: str) -> Optional[ctypes.CDLL]:
+    if name in _libs:
+        return _libs[name]
+    path = os.path.join(_NATIVE_DIR, name)
+    if not os.path.exists(path):
+        try:  # build on first use if the toolchain is present
+            subprocess.run(["make", "-C", _NATIVE_DIR, name], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            _libs[name] = None
+            return None
+    try:
+        _libs[name] = ctypes.CDLL(path)
+    except OSError:
+        _libs[name] = None
+    return _libs[name]
+
+
+def sim_lib() -> Optional[ctypes.CDLL]:
+    lib = _load("libffsim.so")
+    if lib is not None and not getattr(lib, "_ff_configured", False):
+        lib.ffsim_simulate.restype = ctypes.c_double
+        lib.ffsim_simulate.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib._ff_configured = True
+    return lib
+
+
+def data_lib() -> Optional[ctypes.CDLL]:
+    lib = _load("libffdata.so")
+    if lib is not None and not getattr(lib, "_ff_configured", False):
+        lib.ffdata_gather_rows.restype = None
+        lib.ffdata_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+        lib._ff_configured = True
+    return lib
+
+
+def gather_rows(src, indices, out=None):
+    """Multithreaded row gather: out[i] = src[indices[i]].  Falls back to
+    numpy fancy indexing when the native lib is unavailable."""
+    import numpy as np
+
+    src = np.ascontiguousarray(src)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    lib = data_lib()
+    if lib is None or src.ndim < 2:
+        return src[indices]
+    batch = len(indices)
+    if out is None:
+        out = np.empty((batch,) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:]))
+    nthreads = min(8, max(1, os.cpu_count() or 1))
+    lib.ffdata_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        batch, row_bytes, nthreads)
+    return out
+
+
+def simulate_dag(run_times, devices, edge_src, edge_dst) -> Optional[float]:
+    """Native event simulation; returns None when the lib is unavailable
+    (caller falls back to the Python engine), raises on graph cycles."""
+    import numpy as np
+
+    lib = sim_lib()
+    if lib is None:
+        return None
+    rt = np.ascontiguousarray(run_times, dtype=np.float64)
+    dv = np.ascontiguousarray(devices, dtype=np.int64)
+    es = np.ascontiguousarray(edge_src, dtype=np.int32)
+    ed = np.ascontiguousarray(edge_dst, dtype=np.int32)
+    res = lib.ffsim_simulate(
+        len(rt), rt.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        dv.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(es), es.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ed.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if res < 0:
+        raise RuntimeError("cycle in simulated task graph")
+    return float(res)

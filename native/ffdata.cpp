@@ -1,0 +1,46 @@
+// Native dataloader batch gather.
+//
+// TPU-native counterpart of the reference's per-GPU batch scatter kernels
+// (reference: python/flexflow_dataloader.cu, examples/cpp/AlexNet/
+// alexnet.cu:19-90 — each device's copy kernel gathers its shard's
+// samples from zero-copy memory).  On TPU the host assembles the batch
+// (then jax.device_put DMA-transfers each shard), so the gather is a
+// host-side multithreaded strided memcpy: rows `indices[0..batch)` of a
+// contiguous (num_samples, row_bytes) dataset into a contiguous batch
+// buffer.  numpy fancy-indexing does this single-threaded; this is the
+// parallel version for large rows (images).
+//
+// Build: make -C native   (produces libffdata.so)
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows: dst[i, :] = src[indices[i], :] for i in [0, batch).
+void ffdata_gather_rows(const uint8_t* src, uint8_t* dst,
+                        const int64_t* indices, int64_t batch,
+                        int64_t row_bytes, int32_t num_threads) {
+  if (num_threads <= 1 || batch < num_threads * 4) {
+    for (int64_t i = 0; i < batch; i++)
+      std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes, row_bytes);
+    return;
+  }
+  std::vector<std::thread> workers;
+  int64_t chunk = (batch + num_threads - 1) / num_threads;
+  for (int32_t w = 0; w < num_threads; w++) {
+    int64_t lo = w * chunk;
+    int64_t hi = lo + chunk < batch ? lo + chunk : batch;
+    if (lo >= hi) break;
+    workers.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; i++)
+        std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                    row_bytes);
+    });
+  }
+  for (auto& t : workers) t.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+// Native task-graph event-simulation engine.
+//
+// TPU-native counterpart of the reference's C++ simulator hot loop
+// (reference: src/runtime/simulator.cc:410-443 — priority-queue event
+// simulation).  The MCMC search calls simulate_runtime once per candidate
+// strategy; at search budgets of 10^4-10^5 iterations the event loop
+// dominates, so it lives here as a C-ABI shared library driven from
+// Python via ctypes (the task graph is built in Python, flattened to
+// arrays, and executed here).
+//
+// Device encoding: each task carries an int64 device key (chips >= 0,
+// links < 0); the engine only needs keys to serialize per-device.
+//
+// Build: make -C native   (produces libffsim.so)
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Task {
+  double run_time;
+  double ready_time;
+  int64_t device;
+  int32_t counter;
+  int32_t order;
+};
+
+struct QEntry {
+  double ready;
+  int32_t order;
+  int32_t idx;
+};
+
+struct QCmp {
+  bool operator()(const QEntry& a, const QEntry& b) const {
+    if (a.ready != b.ready) return a.ready > b.ready;
+    return a.order > b.order;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Simulate a DAG of n tasks.
+//   run_times[n]  : per-task compute/comm seconds
+//   devices[n]    : per-task device key
+//   edge_src/dst  : m dependency edges (src must finish before dst starts)
+// Returns the makespan in seconds, or -1.0 on a cycle.
+double ffsim_simulate(int32_t n, const double* run_times,
+                      const int64_t* devices, int32_t m,
+                      const int32_t* edge_src, const int32_t* edge_dst) {
+  std::vector<Task> tasks(n);
+  std::vector<std::vector<int32_t>> next(n);
+  for (int32_t i = 0; i < n; i++) {
+    tasks[i].run_time = run_times[i];
+    tasks[i].ready_time = 0.0;
+    tasks[i].device = devices[i];
+    tasks[i].counter = 0;
+    tasks[i].order = i;
+  }
+  for (int32_t e = 0; e < m; e++) {
+    next[edge_src[e]].push_back(edge_dst[e]);
+    tasks[edge_dst[e]].counter++;
+  }
+  std::priority_queue<QEntry, std::vector<QEntry>, QCmp> ready;
+  for (int32_t i = 0; i < n; i++)
+    if (tasks[i].counter == 0) ready.push({0.0, i, i});
+
+  std::unordered_map<int64_t, double> device_time;
+  device_time.reserve(64);
+  double sim_time = 0.0;
+  int32_t processed = 0;
+  while (!ready.empty()) {
+    QEntry qe = ready.top();
+    ready.pop();
+    Task& t = tasks[qe.idx];
+    double dev_free = 0.0;
+    auto it = device_time.find(t.device);
+    if (it != device_time.end()) dev_free = it->second;
+    double start = t.ready_time > dev_free ? t.ready_time : dev_free;
+    double end = start + t.run_time;
+    device_time[t.device] = end;
+    if (end > sim_time) sim_time = end;
+    processed++;
+    for (int32_t nx : next[qe.idx]) {
+      Task& nt = tasks[nx];
+      if (end > nt.ready_time) nt.ready_time = end;
+      if (--nt.counter == 0) ready.push({nt.ready_time, nt.order, nx});
+    }
+  }
+  if (processed != n) return -1.0;  // cycle
+  return sim_time;
+}
+
+}  // extern "C"

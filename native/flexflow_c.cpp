@@ -1,0 +1,494 @@
+/* C API implementation: embeds CPython and drives flexflow_tpu.
+ *
+ * Mirror-image of the reference architecture: the reference embeds a
+ * Python interpreter inside a Legion task (python/main.cc) and wraps a
+ * C++ core in C for cffi (python/flexflow_c.cc); here the core is Python,
+ * so the C surface embeds the interpreter.  All handles are PyObject*.
+ */
+
+#include "flexflow_c.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+PyObject* g_module = nullptr;   // flexflow_tpu
+PyObject* g_np = nullptr;       // numpy
+
+bool ensure_init() {
+  if (g_module) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  // FLEXFLOW_TPU_PLATFORM=cpu|tpu|... wins over any site-level backend
+  // selection (some environments force a platform from sitecustomize).
+  const char* plat = getenv("FLEXFLOW_TPU_PLATFORM");
+  if (plat && *plat) {
+    std::string code = "import jax\njax.config.update('jax_platforms', '";
+    code += plat;
+    code += "')\n";
+    PyRun_SimpleString(code.c_str());
+  }
+  g_module = PyImport_ImportModule("flexflow_tpu");
+  if (!g_module) {
+    PyErr_Print();
+    return false;
+  }
+  g_np = PyImport_ImportModule("numpy");
+  if (!g_np) {
+    PyErr_Print();
+    return false;
+  }
+  return true;
+}
+
+PyObject* call(PyObject* obj, const char* method, PyObject* args,
+               PyObject* kwargs = nullptr) {
+  PyObject* fn = PyObject_GetAttrString(obj, method);
+  if (!fn) { PyErr_Print(); return nullptr; }
+  PyObject* res = PyObject_Call(fn, args, kwargs);
+  Py_DECREF(fn);
+  if (!res) PyErr_Print();
+  return res;
+}
+
+// Build a numpy array copying C data. fmt: 'f' float32, 'i' int32.
+PyObject* np_array(const void* data, int64_t count, const int* dims, int ndims,
+                   char fmt) {
+  PyObject* list = PyList_New(count);
+  if (fmt == 'f') {
+    const float* p = static_cast<const float*>(data);
+    for (int64_t i = 0; i < count; i++)
+      PyList_SET_ITEM(list, i, PyFloat_FromDouble(p[i]));
+  } else {
+    const int32_t* p = static_cast<const int32_t*>(data);
+    for (int64_t i = 0; i < count; i++)
+      PyList_SET_ITEM(list, i, PyLong_FromLong(p[i]));
+  }
+  PyObject* arr = call(g_np, "array", Py_BuildValue("(O)", list),
+                       Py_BuildValue("{s:s}", "dtype",
+                                     fmt == 'f' ? "float32" : "int32"));
+  Py_DECREF(list);
+  if (!arr) return nullptr;
+  if (ndims > 1) {
+    PyObject* shape = PyTuple_New(ndims);
+    for (int i = 0; i < ndims; i++)
+      PyTuple_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+    PyObject* reshaped = call(arr, "reshape", Py_BuildValue("(O)", shape));
+    Py_DECREF(shape);
+    Py_DECREF(arr);
+    return reshaped;
+  }
+  return arr;
+}
+
+PyObject* H(void* impl) { return static_cast<PyObject*>(impl); }
+
+const char* kActNames[] = {"none", "relu", "sigmoid", "tanh"};
+
+// Per-model pending batch: dict tensor-> array kept on the model object
+// via a Python attribute so lifetimes follow the model handle.
+int stage_input(flexflow_model_t m, PyObject* tensor, PyObject* arr) {
+  if (!arr) return -1;
+  PyObject* model = H(m.impl);
+  PyObject* staged = PyObject_GetAttrString(model, "_c_api_batch");
+  if (!staged || staged == Py_None) {
+    Py_XDECREF(staged);
+    staged = PyDict_New();
+    PyObject_SetAttrString(model, "_c_api_batch", staged);
+  }
+  PyDict_SetItem(staged, tensor, arr);
+  Py_DECREF(staged);
+  Py_DECREF(arr);
+  return 0;
+}
+
+int flush_batch_if_ready(flexflow_model_t m) {
+  PyObject* model = H(m.impl);
+  PyObject* staged = PyObject_GetAttrString(model, "_c_api_batch");
+  PyObject* label = PyObject_GetAttrString(model, "_c_api_label");
+  int ok = -1;
+  if (staged && staged != Py_None && label && label != Py_None) {
+    PyObject* res = call(model, "set_batch",
+                         Py_BuildValue("(OO)", staged, label));
+    if (res) { ok = 0; Py_DECREF(res); }
+    PyObject_SetAttrString(model, "_c_api_batch", Py_None);
+    PyObject_SetAttrString(model, "_c_api_label", Py_None);
+  } else {
+    ok = 0;  // nothing staged: batch already set
+  }
+  Py_XDECREF(staged);
+  Py_XDECREF(label);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+int flexflow_init(void) { return ensure_init() ? 0 : -1; }
+
+void flexflow_finalize(void) { /* keep interpreter alive: cheap + safe */ }
+
+flexflow_config_t flexflow_config_create(int batch_size, int epochs,
+                                         int num_devices) {
+  flexflow_config_t out{nullptr};
+  if (!ensure_init()) return out;
+  PyObject* cls = PyObject_GetAttrString(g_module, "FFConfig");
+  PyObject* kw = Py_BuildValue("{s:i,s:i}", "batch_size", batch_size,
+                               "epochs", epochs);
+  if (num_devices > 0) {
+    PyObject* v = PyLong_FromLong(num_devices);
+    PyDict_SetItemString(kw, "workers_per_node", v);
+    Py_DECREF(v);
+  }
+  PyObject* empty = PyTuple_New(0);
+  out.impl = PyObject_Call(cls, empty, kw);
+  if (!out.impl) PyErr_Print();
+  Py_DECREF(empty);
+  Py_DECREF(kw);
+  Py_DECREF(cls);
+  return out;
+}
+
+void flexflow_config_destroy(flexflow_config_t c) { Py_XDECREF(H(c.impl)); }
+
+flexflow_model_t flexflow_model_create(flexflow_config_t c) {
+  flexflow_model_t out{nullptr};
+  if (!ensure_init()) return out;
+  PyObject* cls = PyObject_GetAttrString(g_module, "FFModel");
+  out.impl = PyObject_CallFunctionObjArgs(cls, H(c.impl), nullptr);
+  if (!out.impl) PyErr_Print();
+  Py_DECREF(cls);
+  return out;
+}
+
+void flexflow_model_destroy(flexflow_model_t m) { Py_XDECREF(H(m.impl)); }
+
+flexflow_tensor_t flexflow_tensor_create(flexflow_model_t m, int ndims,
+                                         const int* dims, const char* dtype) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* shape = PyTuple_New(ndims);
+  for (int i = 0; i < ndims; i++)
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+  PyObject* kw = Py_BuildValue("{s:s}", "dtype", dtype ? dtype : "float32");
+  out.impl = call(H(m.impl), "create_tensor", Py_BuildValue("(O)", shape), kw);
+  Py_DECREF(shape);
+  Py_DECREF(kw);
+  return out;
+}
+
+void flexflow_tensor_destroy(flexflow_tensor_t t) { Py_XDECREF(H(t.impl)); }
+
+flexflow_tensor_t flexflow_model_add_conv2d(
+    flexflow_model_t m, flexflow_tensor_t input, int out_channels,
+    int kernel_h, int kernel_w, int stride_h, int stride_w, int padding_h,
+    int padding_w, int activation, int use_bias, const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:s,s:O}", "activation",
+                               kActNames[activation & 3], "use_bias",
+                               use_bias ? Py_True : Py_False);
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", n);
+    Py_DECREF(n);
+  }
+  out.impl = call(H(m.impl), "conv2d",
+                  Py_BuildValue("(Oiiiiiii)", H(input.impl), out_channels,
+                                kernel_h, kernel_w, stride_h, stride_w,
+                                padding_h, padding_w),
+                  kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_pool2d(
+    flexflow_model_t m, flexflow_tensor_t input, int kernel_h, int kernel_w,
+    int stride_h, int stride_w, int padding_h, int padding_w, int pool_max,
+    const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:s}", "pool_type", pool_max ? "max" : "avg");
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", n);
+    Py_DECREF(n);
+  }
+  out.impl = call(H(m.impl), "pool2d",
+                  Py_BuildValue("(Oiiiiii)", H(input.impl), kernel_h, kernel_w,
+                                stride_h, stride_w, padding_h, padding_w),
+                  kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_dense(flexflow_model_t m,
+                                           flexflow_tensor_t input,
+                                           int out_dim, int activation,
+                                           int use_bias, const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:s,s:O}", "activation",
+                               kActNames[activation & 3], "use_bias",
+                               use_bias ? Py_True : Py_False);
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", n);
+    Py_DECREF(n);
+  }
+  out.impl = call(H(m.impl), "dense",
+                  Py_BuildValue("(Oi)", H(input.impl), out_dim), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_flat(flexflow_model_t m,
+                                          flexflow_tensor_t input,
+                                          const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = PyDict_New();
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", n);
+    Py_DECREF(n);
+  }
+  out.impl = call(H(m.impl), "flat", Py_BuildValue("(O)", H(input.impl)), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_softmax(flexflow_model_t m,
+                                             flexflow_tensor_t input,
+                                             const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = PyDict_New();
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", n);
+    Py_DECREF(n);
+  }
+  out.impl =
+      call(H(m.impl), "softmax", Py_BuildValue("(O)", H(input.impl)), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_embedding(flexflow_model_t m,
+                                               flexflow_tensor_t input,
+                                               int num_entries, int out_dim,
+                                               int aggr_sum, const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:s}", "aggr", aggr_sum ? "sum" : "avg");
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", n);
+    Py_DECREF(n);
+  }
+  out.impl = call(H(m.impl), "embedding",
+                  Py_BuildValue("(Oii)", H(input.impl), num_entries, out_dim),
+                  kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_concat(flexflow_model_t m, int n,
+                                            const flexflow_tensor_t* inputs,
+                                            int axis, const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* list = PyList_New(n);
+  for (int i = 0; i < n; i++) {
+    Py_INCREF(H(inputs[i].impl));
+    PyList_SET_ITEM(list, i, H(inputs[i].impl));
+  }
+  PyObject* kw = PyDict_New();
+  if (name) {
+    PyObject* nm = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", nm);
+    Py_DECREF(nm);
+  }
+  out.impl = call(H(m.impl), "concat", Py_BuildValue("(Oi)", list, axis), kw);
+  Py_DECREF(list);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_add(flexflow_model_t m,
+                                         flexflow_tensor_t a,
+                                         flexflow_tensor_t b,
+                                         const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = PyDict_New();
+  if (name) {
+    PyObject* nm = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", nm);
+    Py_DECREF(nm);
+  }
+  out.impl = call(H(m.impl), "add",
+                  Py_BuildValue("(OO)", H(a.impl), H(b.impl)), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+int flexflow_model_compile(flexflow_model_t m, const char* optimizer,
+                           double lr, const char* loss, const char** metrics,
+                           int num_metrics) {
+  PyObject* optcls = PyObject_GetAttrString(
+      g_module, strcmp(optimizer, "adam") == 0 ? "AdamOptimizer"
+                                               : "SGDOptimizer");
+  PyObject* kw = strcmp(optimizer, "adam") == 0
+                     ? Py_BuildValue("{s:d}", "alpha", lr)
+                     : Py_BuildValue("{s:d}", "lr", lr);
+  PyObject* empty = PyTuple_New(0);
+  PyObject* opt = PyObject_Call(optcls, empty, kw);
+  Py_DECREF(empty);
+  Py_DECREF(kw);
+  Py_DECREF(optcls);
+  if (!opt) { PyErr_Print(); return -1; }
+  PyObject* mlist = PyList_New(num_metrics);
+  for (int i = 0; i < num_metrics; i++)
+    PyList_SET_ITEM(mlist, i, PyUnicode_FromString(metrics[i]));
+  PyObject* res = call(H(m.impl), "compile",
+                       Py_BuildValue("(OsO)", opt, loss, mlist));
+  Py_DECREF(opt);
+  Py_DECREF(mlist);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int flexflow_model_init_layers(flexflow_model_t m) {
+  PyObject* res = call(H(m.impl), "init_layers", PyTuple_New(0));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int flexflow_model_set_input_f32(flexflow_model_t m, flexflow_tensor_t t,
+                                 const float* data, int64_t count) {
+  // reshape to the tensor's *native* dims: C callers pass reference-order
+  // data for 4-D (N,C,H,W) — convert via numpy transpose
+  PyObject* tensor = H(t.impl);
+  PyObject* dims_obj = PyObject_GetAttrString(tensor, "dims");
+  int nd = (int)PyTuple_Size(dims_obj);
+  std::vector<int> dims(nd);
+  for (int i = 0; i < nd; i++)
+    dims[i] = (int)PyLong_AsLong(PyTuple_GetItem(dims_obj, i));
+  Py_DECREF(dims_obj);
+  std::vector<int> cdims(dims);
+  if (nd == 4) {  // caller provides N,C,H,W; tensor dims are N,H,W,C
+    cdims[1] = dims[3]; cdims[2] = dims[1]; cdims[3] = dims[2];
+  }
+  PyObject* arr = np_array(data, count, cdims.data(), nd, 'f');
+  if (!arr) return -1;
+  if (nd == 4) {
+    PyObject* tr = call(arr, "transpose", Py_BuildValue("(iiii)", 0, 2, 3, 1));
+    Py_DECREF(arr);
+    arr = tr;
+    if (!arr) return -1;
+  }
+  return stage_input(m, tensor, arr);
+}
+
+int flexflow_model_set_input_i32(flexflow_model_t m, flexflow_tensor_t t,
+                                 const int32_t* data, int64_t count) {
+  PyObject* tensor = H(t.impl);
+  PyObject* dims_obj = PyObject_GetAttrString(tensor, "dims");
+  int nd = (int)PyTuple_Size(dims_obj);
+  std::vector<int> dims(nd);
+  for (int i = 0; i < nd; i++)
+    dims[i] = (int)PyLong_AsLong(PyTuple_GetItem(dims_obj, i));
+  Py_DECREF(dims_obj);
+  PyObject* arr = np_array(data, count, dims.data(), nd, 'i');
+  if (!arr) return -1;
+  return stage_input(m, tensor, arr);
+}
+
+static int set_label(flexflow_model_t m, PyObject* arr) {
+  if (!arr) return -1;
+  PyObject_SetAttrString(H(m.impl), "_c_api_label", arr);
+  Py_DECREF(arr);
+  return flush_batch_if_ready(m);
+}
+
+int flexflow_model_set_label_i32(flexflow_model_t m, const int32_t* data,
+                                 int64_t count) {
+  PyObject* model = H(m.impl);
+  PyObject* lt = PyObject_GetAttrString(model, "label_tensor");
+  PyObject* dims_obj = PyObject_GetAttrString(lt, "dims");
+  int nd = (int)PyTuple_Size(dims_obj);
+  std::vector<int> dims(nd);
+  for (int i = 0; i < nd; i++)
+    dims[i] = (int)PyLong_AsLong(PyTuple_GetItem(dims_obj, i));
+  Py_DECREF(dims_obj);
+  Py_DECREF(lt);
+  return set_label(m, np_array(data, count, dims.data(), nd, 'i'));
+}
+
+int flexflow_model_set_label_f32(flexflow_model_t m, const float* data,
+                                 int64_t count) {
+  PyObject* model = H(m.impl);
+  PyObject* lt = PyObject_GetAttrString(model, "label_tensor");
+  PyObject* dims_obj = PyObject_GetAttrString(lt, "dims");
+  int nd = (int)PyTuple_Size(dims_obj);
+  std::vector<int> dims(nd);
+  for (int i = 0; i < nd; i++)
+    dims[i] = (int)PyLong_AsLong(PyTuple_GetItem(dims_obj, i));
+  Py_DECREF(dims_obj);
+  Py_DECREF(lt);
+  return set_label(m, np_array(data, count, dims.data(), nd, 'f'));
+}
+
+static int simple_call(flexflow_model_t m, const char* method) {
+  PyObject* res = call(H(m.impl), method, PyTuple_New(0));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int flexflow_model_forward(flexflow_model_t m) {
+  if (flush_batch_if_ready(m) != 0) return -1;
+  return simple_call(m, "forward");
+}
+int flexflow_model_zero_gradients(flexflow_model_t m) {
+  return simple_call(m, "zero_gradients");
+}
+int flexflow_model_backward(flexflow_model_t m) {
+  return simple_call(m, "backward");
+}
+int flexflow_model_update(flexflow_model_t m) {
+  return simple_call(m, "update");
+}
+int flexflow_model_sync(flexflow_model_t m) { return simple_call(m, "sync"); }
+
+void flexflow_model_reset_metrics(flexflow_model_t m) {
+  simple_call(m, "reset_metrics");
+}
+
+double flexflow_model_get_accuracy(flexflow_model_t m, int64_t* train_all,
+                                   int64_t* train_correct) {
+  PyObject* pm = call(H(m.impl), "get_metrics", PyTuple_New(0));
+  if (!pm) return -1.0;
+  PyObject* acc = PyObject_GetAttrString(pm, "accuracy");
+  PyObject* ta = PyObject_GetAttrString(pm, "train_all");
+  PyObject* tc = PyObject_GetAttrString(pm, "train_correct");
+  double result = acc ? PyFloat_AsDouble(acc) : -1.0;
+  if (train_all && ta) *train_all = PyLong_AsLongLong(ta);
+  if (train_correct && tc) *train_correct = PyLong_AsLongLong(tc);
+  Py_XDECREF(acc); Py_XDECREF(ta); Py_XDECREF(tc); Py_DECREF(pm);
+  return result;
+}
+
+int flexflow_tensor_get_dims(flexflow_tensor_t t, int* dims) {
+  PyObject* dims_obj = PyObject_GetAttrString(H(t.impl), "dims");
+  if (!dims_obj) return -1;
+  int nd = (int)PyTuple_Size(dims_obj);
+  for (int i = 0; i < nd && i < 8; i++)
+    dims[i] = (int)PyLong_AsLong(PyTuple_GetItem(dims_obj, i));
+  Py_DECREF(dims_obj);
+  return nd;
+}
+
+}  // extern "C"

@@ -1,0 +1,111 @@
+/* C API for the flexflow_tpu framework.
+ *
+ * Counterpart of the reference C API (reference: python/flexflow_c.h —
+ * ~190 extern "C" wrappers with opaque handle structs over FFModel).  The
+ * reference wraps a C++ core for Python/cffi; this framework's core is the
+ * Python/JAX SPMD layer, so the C API embeds CPython and drives the same
+ * objects — C callers get the reference-style surface (opaque handles,
+ * flexflow_model_add_* builders, compile/train-step calls) with the TPU
+ * execution engine underneath.
+ *
+ * Link: -lflexflow_c (built by native/Makefile) plus the Python runtime.
+ * The process must be able to `import flexflow_tpu` (set PYTHONPATH).
+ */
+
+#ifndef FLEXFLOW_TPU_C_H
+#define FLEXFLOW_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct flexflow_config_t { void* impl; } flexflow_config_t;
+typedef struct flexflow_model_t { void* impl; } flexflow_model_t;
+typedef struct flexflow_tensor_t { void* impl; } flexflow_tensor_t;
+
+/* runtime */
+int flexflow_init(void);          /* idempotent; returns 0 on success */
+void flexflow_finalize(void);
+
+/* config (reference: flexflow_config_create / parse_args) */
+flexflow_config_t flexflow_config_create(int batch_size, int epochs,
+                                         int num_devices);
+void flexflow_config_destroy(flexflow_config_t c);
+
+/* model + tensors */
+flexflow_model_t flexflow_model_create(flexflow_config_t c);
+void flexflow_model_destroy(flexflow_model_t m);
+/* dims reference-ordered (N,C,H,W for 4-D); dtype "float32"|"int32"|"int64" */
+flexflow_tensor_t flexflow_tensor_create(flexflow_model_t m, int ndims,
+                                         const int* dims, const char* dtype);
+void flexflow_tensor_destroy(flexflow_tensor_t t);
+
+/* layer builders (reference: flexflow_model_add_*; activation:
+ * 0=none 1=relu 2=sigmoid 3=tanh) */
+flexflow_tensor_t flexflow_model_add_conv2d(
+    flexflow_model_t m, flexflow_tensor_t input, int out_channels,
+    int kernel_h, int kernel_w, int stride_h, int stride_w,
+    int padding_h, int padding_w, int activation, int use_bias,
+    const char* name);
+flexflow_tensor_t flexflow_model_add_pool2d(
+    flexflow_model_t m, flexflow_tensor_t input, int kernel_h, int kernel_w,
+    int stride_h, int stride_w, int padding_h, int padding_w,
+    int pool_max /*1=max 0=avg*/, const char* name);
+flexflow_tensor_t flexflow_model_add_dense(
+    flexflow_model_t m, flexflow_tensor_t input, int out_dim, int activation,
+    int use_bias, const char* name);
+flexflow_tensor_t flexflow_model_add_flat(flexflow_model_t m,
+                                          flexflow_tensor_t input,
+                                          const char* name);
+flexflow_tensor_t flexflow_model_add_softmax(flexflow_model_t m,
+                                             flexflow_tensor_t input,
+                                             const char* name);
+flexflow_tensor_t flexflow_model_add_embedding(
+    flexflow_model_t m, flexflow_tensor_t input, int num_entries, int out_dim,
+    int aggr_sum /*1=sum 0=avg*/, const char* name);
+flexflow_tensor_t flexflow_model_add_concat(
+    flexflow_model_t m, int n, const flexflow_tensor_t* inputs, int axis,
+    const char* name);
+flexflow_tensor_t flexflow_model_add_add(flexflow_model_t m,
+                                         flexflow_tensor_t a,
+                                         flexflow_tensor_t b,
+                                         const char* name);
+
+/* compile: optimizer "sgd"|"adam"; loss per reference names */
+int flexflow_model_compile(flexflow_model_t m, const char* optimizer,
+                           double lr, const char* loss,
+                           const char** metrics, int num_metrics);
+int flexflow_model_init_layers(flexflow_model_t m);
+
+/* batch feeding (host data, reference-ordered layout) */
+int flexflow_model_set_input_f32(flexflow_model_t m, flexflow_tensor_t t,
+                                 const float* data, int64_t count);
+int flexflow_model_set_input_i32(flexflow_model_t m, flexflow_tensor_t t,
+                                 const int32_t* data, int64_t count);
+int flexflow_model_set_label_i32(flexflow_model_t m, const int32_t* data,
+                                 int64_t count);
+int flexflow_model_set_label_f32(flexflow_model_t m, const float* data,
+                                 int64_t count);
+
+/* train drivers (reference: forward/zero_gradients/backward/update) */
+int flexflow_model_forward(flexflow_model_t m);
+int flexflow_model_zero_gradients(flexflow_model_t m);
+int flexflow_model_backward(flexflow_model_t m);
+int flexflow_model_update(flexflow_model_t m);
+int flexflow_model_sync(flexflow_model_t m);
+void flexflow_model_reset_metrics(flexflow_model_t m);
+
+/* metrics: returns accuracy %; train_all/correct optional out-params */
+double flexflow_model_get_accuracy(flexflow_model_t m, int64_t* train_all,
+                                   int64_t* train_correct);
+
+/* tensor introspection */
+int flexflow_tensor_get_dims(flexflow_tensor_t t, int* dims /*>=4 slots*/);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FLEXFLOW_TPU_C_H */
