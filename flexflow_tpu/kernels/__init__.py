@@ -1,0 +1,18 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference implements its hot paths as hand-written CUDA kernels
+(src/ops/*.cu, src/runtime/optimizer_kernel.cu).  The TPU-native
+equivalent: XLA already fuses the elementwise graph, so custom kernels
+are reserved for the ops where manual VMEM scheduling beats the
+compiler — blockwise (flash) attention and the fused optimizer updates.
+"""
+
+from .flash_attention import flash_attention, mha_reference
+from .fused_optimizer import fused_sgd_update, fused_adam_update
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "fused_sgd_update",
+    "fused_adam_update",
+]
