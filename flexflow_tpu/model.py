@@ -151,6 +151,33 @@ class FFModel:
         self.ops.append(op)
         return op.outputs[0], op.outputs[1], op.outputs[2]
 
+    def multihead_attention(self, query: Tensor, key: Optional[Tensor] = None,
+                            value: Optional[Tensor] = None,
+                            embed_dim: Optional[int] = None, num_heads: int = 8,
+                            causal: bool = False, dropout: float = 0.0,
+                            use_bias: bool = False, kernel_initializer=None,
+                            seq_parallel_mode: str = "ring",
+                            name: Optional[str] = None) -> Tensor:
+        """Multi-head attention (B,S,E)→(B,S,E); self-attention when key/
+        value are omitted.  Sequence-dim partition degrees in this op's
+        strategy lower to ring attention over ICI (parallel/sequence.py)."""
+        from .ops.attention import MultiHeadAttention
+
+        key = key if key is not None else query
+        value = value if value is not None else key
+        embed_dim = embed_dim if embed_dim is not None else query.dims[-1]
+        return self._append(MultiHeadAttention(
+            self, query, key, value, embed_dim, num_heads, causal, dropout,
+            use_bias, kernel_initializer, seq_parallel_mode, name))
+
+    def layer_norm(self, input_tensor: Tensor, eps: float = 1e-5,
+                   elementwise_affine: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        from .ops.attention import LayerNorm
+
+        return self._append(LayerNorm(self, input_tensor, eps,
+                                      elementwise_affine, name))
+
     def concat(self, tensors: Sequence[Tensor], axis: int,
                name: Optional[str] = None) -> Tensor:
         # Reference axis is in NCHW logical order (concat.cu); convert the

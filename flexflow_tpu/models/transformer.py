@@ -1,0 +1,54 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+The reference predates transformers; this model exists to exercise the
+TPU-first capabilities the framework adds on top of the reference's
+feature set: fused flash attention (pallas), ring-attention sequence
+parallelism, and hybrid dp×sp×tp shardings of one op graph.  The graph is
+built through the same FFModel op vocabulary as every reference model
+(embedding/dense/layer_norm/multihead_attention/element add), so the
+strategy machinery (SOAP configs, MCMC search, protobuf export) applies
+to it unchanged.
+"""
+
+from __future__ import annotations
+
+from ..model import FFModel
+from ..ops.embedding import AggrMode
+
+
+def build_transformer(ff: FFModel, batch_size: int, seq_length: int = 256,
+                      num_layers: int = 4, embed_dim: int = 512,
+                      num_heads: int = 8, mlp_ratio: int = 4,
+                      vocab_size: int = 32000, dropout: float = 0.0):
+    """Returns (tokens_tensor, positions_tensor, softmax_output).
+
+    tokens/positions: (B, S) int32 — positions are 0..S-1 per row (the
+    dataloader supplies them; synthetic mode generates arange).  Labels
+    are next-token ids, shape (B, S) int32.
+    """
+    tok = ff.create_tensor((batch_size, seq_length), name="tokens",
+                           dtype="int32", nchw=False)
+    pos = ff.create_tensor((batch_size, seq_length), name="positions",
+                           dtype="int32", nchw=False)
+
+    x = ff.embedding(tok, vocab_size, embed_dim, aggr=AggrMode.NONE,
+                     name="tok_embed")
+    p = ff.embedding(pos, seq_length, embed_dim, aggr=AggrMode.NONE,
+                     name="pos_embed")
+    x = ff.add(x, p, name="embed_add")
+
+    for i in range(num_layers):
+        h = ff.layer_norm(x, name=f"ln1_{i}")
+        h = ff.multihead_attention(h, num_heads=num_heads, causal=True,
+                                   dropout=dropout, name=f"attn_{i}")
+        x = ff.add(x, h, name=f"res_attn_{i}")
+        h = ff.layer_norm(x, name=f"ln2_{i}")
+        h = ff.dense(h, embed_dim * mlp_ratio, activation="gelu",
+                     name=f"mlp_up_{i}")
+        h = ff.dense(h, embed_dim, name=f"mlp_down_{i}")
+        x = ff.add(x, h, name=f"res_mlp_{i}")
+
+    x = ff.layer_norm(x, name="ln_f")
+    logits = ff.dense(x, vocab_size, name="lm_head")
+    out = ff.softmax(logits, name="softmax")
+    return tok, pos, out

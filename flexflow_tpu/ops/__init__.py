@@ -6,3 +6,4 @@ from .embedding import AggrMode, Embedding
 from .linear import Linear
 from .misc import (BatchNorm, Concat, Dropout, ElementBinary, ElementUnary,
                    Flat, MSELoss, Softmax)
+from .attention import LayerNorm, MultiHeadAttention

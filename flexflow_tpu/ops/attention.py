@@ -1,0 +1,167 @@
+"""Multi-head attention + LayerNorm ops — the long-context path.
+
+The reference predates transformers and has no attention op (SURVEY §5.7);
+its SOAP abstraction (partition any output dim, include/config.h:42-51) is
+what these ops extend to the sequence dim.  A MultiHeadAttention output is
+(B, S, E); a ParallelConfig of (dp, sp, 1) lowers to:
+
+  * sp == 1: fused flash attention on-chip (kernels/flash_attention.py,
+    pallas), GSPMD handling dp like any other op;
+  * sp > 1: ring attention over the mesh axes assigned to the sequence
+    dim (parallel/sequence.py) — K/V rotate over ICI via ppermute and
+    per-chip memory stays O(S/sp · S/sp) instead of O(S²).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import FwdCtx, Op
+from ..initializers import ConstantInitializer, DefaultWeightInitializer, ZeroInitializer
+
+
+class LayerNorm(Op):
+    """Normalize over the last dim with learned scale/shift."""
+
+    _type = "LayerNorm"
+
+    def __init__(self, model, input_tensor, eps: float = 1e-5,
+                 elementwise_affine: bool = True, name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        self.eps = eps
+        self.affine = elementwise_affine
+        dims = input_tensor.dims
+        self._add_output(dims, input_tensor.dtype)
+        if elementwise_affine:
+            feat_cfg_dim = len(dims) - 1
+            self._add_weight("scale", (dims[-1],), ConstantInitializer(1.0),
+                             partition_dims=(feat_cfg_dim,))
+            self._add_weight("bias", (dims[-1],), ZeroInitializer(),
+                             partition_dims=(feat_cfg_dim,))
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        x = xs[0]
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+        return [y.astype(x.dtype)]
+
+    def flops_per_sample(self):
+        import numpy as np
+        return 8.0 * float(np.prod(self.output.dims[1:]))
+
+
+class MultiHeadAttention(Op):
+    """Scaled-dot-product multi-head attention with QKV/output projections.
+
+    query/key/value: (B, Sq, E) / (B, Sk, E) / (B, Sk, E).  Output
+    (B, Sq, E).  ``causal`` adds the autoregressive mask (requires
+    Sq == Sk).  Sequence parallelism kicks in when the op's
+    ParallelConfig splits dim 1 — see module docstring.
+    """
+
+    _type = "MultiHeadAttention"
+
+    def __init__(self, model, query, key, value, embed_dim: int,
+                 num_heads: int, causal: bool = False,
+                 dropout: float = 0.0, use_bias: bool = False,
+                 kernel_initializer=None, seq_parallel_mode: str = "ring",
+                 name: Optional[str] = None):
+        super().__init__(model, [query, key, value], name)
+        assert embed_dim % num_heads == 0, "embed_dim must divide by num_heads"
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.dropout = dropout
+        self.use_bias = use_bias
+        self.seq_parallel_mode = seq_parallel_mode
+        b, sq, _ = query.dims
+        self._add_output((b, sq, embed_dim), query.dtype)
+        init = kernel_initializer or DefaultWeightInitializer()
+        for wname, in_dim in (("wq", query.dims[-1]), ("wk", key.dims[-1]),
+                              ("wv", value.dims[-1])):
+            self._add_weight(wname, (in_dim, embed_dim), init,
+                             partition_dims=(None, 2))
+        self._add_weight("wo", (embed_dim, embed_dim), init,
+                         partition_dims=(None, 2))
+        if use_bias:
+            for bname in ("bq", "bk", "bv", "bo"):
+                self._add_weight(bname, (embed_dim,), ZeroInitializer(),
+                                 partition_dims=(2,))
+
+    # -- helpers -----------------------------------------------------------
+    def _proj(self, params, x, w, b):
+        acc = jnp.float32 if x.dtype == jnp.bfloat16 else None
+        y = jnp.dot(x, params[w].astype(x.dtype), preferred_element_type=acc)
+        y = y.astype(x.dtype)
+        if self.use_bias:
+            y = y + params[b].astype(y.dtype)
+        return y
+
+    def _seq_degree(self) -> int:
+        pc = getattr(self, "pc", None)
+        if pc is None or len(pc.dims) < 2:
+            return 1
+        return pc.dims[1]
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        q_in, k_in, v_in = xs
+        B, Sq, _ = q_in.shape
+        H, D = self.num_heads, self.head_dim
+
+        q = self._proj(params, q_in, "wq", "bq")
+        k = self._proj(params, k_in, "wk", "bk")
+        v = self._proj(params, v_in, "wv", "bv")
+        # (B, S, E) -> (B, H, S, D)
+        split = lambda t: t.reshape(t.shape[0], t.shape[1], H, D).transpose(0, 2, 1, 3)
+        qh, kh, vh = split(q), split(k), split(v)
+        scale = 1.0 / math.sqrt(D)
+
+        sp = self._seq_degree()
+        machine = self.model.machine
+        if sp > 1 and machine.num_devices > 1 and Sq == k_in.shape[1]:
+            from ..parallel.sequence import sequence_parallel_attention
+            degrees = list(self.pc.dims) + [1] * (3 - len(self.pc.dims))
+            groups = machine.axes_for_degrees(degrees[:3])
+            batch_axes = groups[0] if groups[0] else None
+            seq_axes = groups[1]
+            oh = sequence_parallel_attention(
+                qh, kh, vh, machine.mesh, seq_axes, batch_axes=batch_axes,
+                causal=self.causal, scale=scale, mode=self.seq_parallel_mode)
+        elif jax.default_backend() == "tpu":
+            from ..kernels.flash_attention import flash_attention
+            oh = flash_attention(qh, kh, vh, causal=self.causal, scale=scale)
+        else:
+            from ..parallel.sequence import blockwise_attention
+            oh, _ = blockwise_attention(qh, kh, vh, causal=self.causal,
+                                        scale=scale)
+        out = oh.transpose(0, 2, 1, 3).reshape(B, Sq, self.embed_dim)
+        if self.dropout > 0.0 and ctx.training:
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(ctx.op_rng(self), keep, out.shape)
+            out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
+        return [self._proj(params, out, "wo", "bo")]
+
+    def flops_per_sample(self):
+        _, sq, e = self.output.dims
+        sk = self.inputs[1].dims[1]
+        proj = 2.0 * sq * e * e * 4
+        attn = 2.0 * self.num_heads * sq * sk * self.head_dim * 2
+        return proj + attn
+
+    def input_ranges(self, j, pc, part_idx):
+        # K/V travel the full ring: a seq shard reads every other shard's
+        # K/V exactly once, so its effective input range is the full seq.
+        rng = super().input_ranges(j, pc, part_idx)
+        if j in (1, 2):
+            in_dims = self.inputs[j].dims
+            rng[1] = (0, in_dims[1] - 1)
+        return rng

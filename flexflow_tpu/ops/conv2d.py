@@ -36,6 +36,7 @@ class ActiMode:
     RELU = "relu"
     SIGMOID = "sigmoid"
     TANH = "tanh"
+    GELU = "gelu"
 
 
 def apply_activation(x, activation: Optional[str]):
@@ -47,6 +48,8 @@ def apply_activation(x, activation: Optional[str]):
         return jax.nn.sigmoid(x)
     if activation == ActiMode.TANH:
         return jnp.tanh(x)
+    if activation == ActiMode.GELU:
+        return jax.nn.gelu(x)
     raise ValueError(f"unknown activation {activation}")
 
 
