@@ -253,8 +253,17 @@ class FFModel:
         self.optimizer = optimizer
         self.loss = Loss(loss_type)
         self.metrics = Metrics(self.loss.loss_type, list(metrics))
-        self.machine = machine or Machine(num_devices=min(
-            cfg.num_devices, len(jax.devices())))
+        if machine is not None:
+            self.machine = machine
+        elif cfg.num_nodes > 1 or jax.process_count() > 1:
+            # Multi-host: hybrid ICI×DCN mesh with the DCN axis leading
+            # (parallel/distributed.py) — the GASNet-multi-node analogue.
+            from .parallel.distributed import hybrid_machine
+            self.machine = hybrid_machine(
+                dcn_degree=max(cfg.num_nodes, jax.process_count()))
+        else:
+            self.machine = Machine(num_devices=min(
+                cfg.num_devices, len(jax.devices())))
 
         if cfg.import_strategy_file:
             cfg.strategies.update(load_strategies_from_file(
@@ -500,6 +509,11 @@ class FFModel:
         if isinstance(arr, jax.Array) and arr.committed:
             return arr
         arr = np.asarray(arr)
+        if jax.process_count() > 1:
+            # Multi-host: ``arr`` is this host's local shard of the global
+            # batch (parallel/distributed.py, host_local_batch).
+            from .parallel.distributed import host_local_batch
+            return host_local_batch(self.machine, arr, degree)
         return jax.device_put(arr, self.machine.batch_sharding(degree))
 
     def forward(self) -> None:

@@ -56,7 +56,16 @@ class Machine:
     sharding lands on the widest axis groups.
     """
 
-    def __init__(self, devices: Optional[Sequence] = None, num_devices: Optional[int] = None):
+    def __init__(self, devices: Optional[Sequence] = None, num_devices: Optional[int] = None,
+                 mesh: Optional[Mesh] = None):
+        if mesh is not None:
+            # Adopt a prebuilt mesh (e.g. a hybrid ICI×DCN mesh from
+            # parallel/distributed.py); axis order is the mesh's order.
+            self.mesh = mesh
+            self.devices = list(mesh.devices.flat)
+            self.axis_names = tuple(mesh.axis_names)
+            self.axis_sizes = tuple(mesh.devices.shape)
+            return
         if devices is None:
             devices = jax.devices()
             if num_devices is not None:
