@@ -1,0 +1,43 @@
+"""Dataset file resolution (reference: keras/utils/data_utils.py get_file).
+
+The reference downloads from S3; this environment is egress-free, so
+``get_file`` only resolves already-present local files and reports the
+search path when missing.  Callers (datasets/*) fall back to synthetic
+data when it returns None.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def _search_dirs():
+    dirs = []
+    env = os.environ.get("FF_DATASET_DIR")
+    if env:
+        dirs.append(env)
+    dirs.append(os.path.join(os.path.expanduser("~"), ".keras", "datasets"))
+    return dirs
+
+
+def locate_file(fname: str) -> Optional[str]:
+    """Return the path of a cached dataset file, or None."""
+    if os.path.isabs(fname) and os.path.exists(fname):
+        return fname
+    for d in _search_dirs():
+        p = os.path.join(d, fname)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def get_file(fname: str, origin: str = "", file_hash: str = "",
+             cache_subdir: str = "datasets") -> Optional[str]:
+    """Reference-compatible signature; resolves locally only.
+
+    Returns the local path if the file is cached, else None (the
+    reference would download ``origin`` here).
+    """
+    del origin, file_hash, cache_subdir
+    return locate_file(fname)

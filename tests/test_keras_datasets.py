@@ -1,0 +1,76 @@
+"""Keras datasets/preprocessing/utils parity.
+
+Mirrors the reference's de-facto test contract (python/test.sh +
+VerifyMetrics callbacks): datasets load with the right shapes, pad/one-hot
+utilities behave like keras, and a Sequential MLP trains on the mnist
+loader's output to threshold accuracy.
+"""
+
+import numpy as np
+
+from flexflow_tpu import keras
+
+
+def test_mnist_shapes():
+    (x, y), (xt, yt) = keras.datasets.mnist.load_data()
+    assert x.shape == (60000, 28, 28) and x.dtype == np.uint8
+    assert y.shape == (60000,)
+    assert xt.shape == (10000, 28, 28)
+    assert set(np.unique(y)) <= set(range(10))
+
+
+def test_cifar10_shapes():
+    (x, y), (xt, yt) = keras.datasets.cifar10.load_data()
+    assert x.shape == (50000, 3, 32, 32) and x.dtype == np.uint8
+    assert y.shape == (50000, 1)
+
+
+def test_reuters_contract():
+    (x, y), (xt, yt) = keras.datasets.reuters.load_data(num_words=1000)
+    assert len(x) + len(xt) == 11228
+    # start_char=1, index_from offset, oov capping
+    assert all(seq[0] == 1 for seq in x[:50])
+    assert max(max(seq) for seq in x[:50]) < 1000
+
+
+def test_pad_sequences():
+    seqs = [[1, 2, 3], [4, 5], [6]]
+    out = keras.preprocessing.pad_sequences(seqs, maxlen=4)
+    np.testing.assert_array_equal(out, [[0, 1, 2, 3], [0, 0, 4, 5], [0, 0, 0, 6]])
+    out = keras.preprocessing.pad_sequences(seqs, maxlen=2, padding="post",
+                                            truncating="post")
+    np.testing.assert_array_equal(out, [[1, 2], [4, 5], [6, 0]])
+
+
+def test_to_categorical():
+    out = keras.utils.to_categorical([0, 2, 1], num_classes=3)
+    np.testing.assert_array_equal(out, np.eye(3)[[0, 2, 1]])
+
+
+def test_tokenizer():
+    tok = keras.preprocessing.text.Tokenizer(num_words=10)
+    tok.fit_on_texts(["the cat sat", "the cat ran", "the dog"])
+    seqs = tok.texts_to_sequences(["the cat", "the dog"])
+    assert seqs[0][0] == tok.word_index["the"] == 1  # most frequent
+    assert len(seqs[1]) == 2
+
+
+def test_seq_mnist_mlp_trains(devices):
+    """Reference: examples/python/keras/seq_mnist_mlp.py + VerifyMetrics."""
+    import flexflow_tpu as ff
+
+    (x_train, y_train), _ = keras.datasets.mnist.load_data()
+    x_train = x_train[:512].reshape(512, 784).astype("float32") / 255
+    y_train = y_train[:512].astype(np.int32)
+
+    model = keras.Sequential(config=ff.FFConfig(batch_size=64,
+                                                compute_dtype="float32"))
+    model.add(keras.layers.Input(shape=(784,)))
+    model.add(keras.layers.Dense(64, activation="relu"))
+    model.add(keras.layers.Dense(10))
+    model.add(keras.layers.Activation("softmax"))
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.2),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = keras.callbacks.VerifyMetrics(accuracy_threshold=60.0)
+    model.fit(x_train, y_train, epochs=20, callbacks=[cb], verbose=False)
