@@ -60,6 +60,10 @@ class ParallelConfig:
     device_type: DeviceType = DeviceType.TPU
     dims: Tuple[int, ...] = (1,)
     device_ids: Tuple[int, ...] = ()
+    # Per-tensor memory placement (reference: Op.memory_types, strategy.proto
+    # FBM=device HBM, ZCM=host pinned).  "hbm"/"host" here; host entries map
+    # to JAX host-offload for CPU-placed embeddings (DLRM).
+    memory_types: Tuple[str, ...] = ()
 
     def __post_init__(self):
         if len(self.dims) == 0 or len(self.dims) > MAX_DIM:

@@ -76,6 +76,9 @@ def _encode_op(name: str, pc: ParallelConfig) -> bytes:
     for d in pc.device_ids:
         _write_tag(buf, 4, _WIRE_VARINT)
         _write_varint(buf, d)
+    for m in pc.memory_types:
+        _write_tag(buf, 5, _WIRE_VARINT)
+        _write_varint(buf, 1 if m in ("host", "ZCM", "zcm") else 0)
     return buf.getvalue()
 
 
@@ -85,6 +88,7 @@ def _decode_op(data: bytes) -> Tuple[str, ParallelConfig]:
     device_type = DeviceType.TPU
     dims: List[int] = []
     device_ids: List[int] = []
+    memory_types: List[str] = []
     while pos < len(data):
         tag, pos = _read_varint(data, pos)
         field, wire = tag >> 3, tag & 0x7
@@ -96,6 +100,8 @@ def _decode_op(data: bytes) -> Tuple[str, ParallelConfig]:
                 dims.append(int(val))
             elif field == 4:
                 device_ids.append(int(val))
+            elif field == 5:
+                memory_types.append("host" if val == 1 else "hbm")
         elif wire == _WIRE_LEN:
             ln, pos = _read_varint(data, pos)
             payload = data[pos:pos + ln]
@@ -110,11 +116,14 @@ def _decode_op(data: bytes) -> Tuple[str, ParallelConfig]:
                         dims.append(int(v))
                     elif field == 4:
                         device_ids.append(int(v))
+                    elif field == 5:
+                        memory_types.append("host" if v == 1 else "hbm")
         else:
             raise ValueError(f"unsupported wire type {wire} in strategy file")
     if not dims:
         dims = [1]
-    return name, ParallelConfig(device_type, tuple(dims), tuple(device_ids))
+    return name, ParallelConfig(device_type, tuple(dims), tuple(device_ids),
+                                tuple(memory_types))
 
 
 def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]) -> None:
@@ -147,6 +156,7 @@ def load_strategies_from_file(filename: str, reference_order: bool = False) -> D
         if field == 1:
             name, pc = _decode_op(payload)
             if reference_order:
-                pc = ParallelConfig(pc.device_type, tuple(reversed(pc.dims)), pc.device_ids)
+                pc = ParallelConfig(pc.device_type, tuple(reversed(pc.dims)),
+                                    pc.device_ids, pc.memory_types)
             out[name] = pc
     return out

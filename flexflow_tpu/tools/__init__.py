@@ -1,0 +1,2 @@
+"""Standalone strategy-generation tools (reference: the strategy-generator
+binaries built at CMakeLists.txt:99-105)."""

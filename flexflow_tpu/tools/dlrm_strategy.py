@@ -1,0 +1,97 @@
+"""DLRM strategy generators (reference: src/runtime/dlrm_strategy.cc and
+dlrm_strategy_hetero.cc — standalone binaries emitting protobuf strategy
+files for the DLRM example).
+
+Two modes, matching the two reference binaries:
+
+  * homogeneous (``generate``): each embedding table pinned to one chip
+    round-robin (reference dims (1,1) + device_id ``i % devices``,
+    dlrm_strategy.cc:184-189), concat split across nodes, MLPs
+    data-parallel over all chips;
+  * hetero (``generate_hetero``): embedding tables placed on the host
+    (device_type=CPU + ZCM memory, dlrm_strategy_hetero.cc:28-35) — on
+    TPU this lowers to host-offloaded tables — with compute ops
+    data-parallel.
+
+Files are wire-compatible with the reference (strategy.proto) and carry
+dims in **reference (adim) order**, so they load with
+``--import-reference-order`` exactly like files the reference tools emit.
+
+CLI: ``python -m flexflow_tpu.tools.dlrm_strategy --gpu 4 --node 2
+[--hetero] [--emb 8] [-o out.pb]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict
+
+from ..config import DeviceType, ParallelConfig
+from ..parallel.strategy import save_strategies_to_file
+
+
+def generate(gpus_per_node: int, num_nodes: int,
+             num_embeddings: int = 24) -> Dict[str, ParallelConfig]:
+    """Homogeneous DLRM strategy (dlrm_strategy.cc main, :175-213).
+
+    Dims are in reference adim order (sample dim LAST): an op config
+    (c, n) here means n sample parts × c channel parts.
+    """
+    total = gpus_per_node * num_nodes
+    out: Dict[str, ParallelConfig] = {}
+    for i in range(num_embeddings):
+        out[f"embedding{i}"] = ParallelConfig(
+            DeviceType.TPU, (1, 1), (i % total,),
+            ("hbm", "hbm", "hbm"))
+    out["concat"] = ParallelConfig(
+        DeviceType.TPU, (1, num_nodes),
+        tuple(i * gpus_per_node for i in range(num_nodes)),
+        ("hbm", "hbm"))
+    out["linear"] = ParallelConfig(
+        DeviceType.TPU, (1, total), tuple(range(total)),
+        ("hbm", "hbm", "hbm"))
+    out["mse_loss"] = ParallelConfig(
+        DeviceType.TPU, (1, total), tuple(range(total)), ("hbm",))
+    return out
+
+
+def generate_hetero(gpus: int = 1, cpus: int = 1,
+                    num_embeddings: int = 8) -> Dict[str, ParallelConfig]:
+    """Heterogeneous strategy: tables on host (dlrm_strategy_hetero.cc)."""
+    out: Dict[str, ParallelConfig] = {}
+    for i in range(num_embeddings):
+        out[f"embedding{i}"] = ParallelConfig(
+            DeviceType.CPU, (1, 1), (i % cpus,), ("host", "host", "host"))
+    for name in ("linear", "mse_loss", "concat"):
+        out[name] = ParallelConfig(
+            DeviceType.TPU, (1, gpus), tuple(range(gpus)))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--gpu", type=int, default=1,
+                    help="chips per node (reference flag name)")
+    ap.add_argument("--node", type=int, default=1)
+    ap.add_argument("--cpu", type=int, default=1, help="hetero: host count")
+    ap.add_argument("--emb", type=int, default=None, help="embedding tables")
+    ap.add_argument("--hetero", action="store_true")
+    ap.add_argument("-o", "--output", default=None)
+    args = ap.parse_args(argv)
+
+    if args.hetero:
+        nemb = args.emb or 8
+        strategies = generate_hetero(args.gpu, args.cpu, nemb)
+        default_name = f"dlrm_strategy_{nemb}nEmb_{args.cpu}cpu_{args.gpu}gpu.pb"
+    else:
+        nemb = args.emb or 24
+        strategies = generate(args.gpu, args.node, nemb)
+        default_name = f"dlrm_strategy_gpu_{args.gpu}_node_{args.node}.pb"
+    out = args.output or default_name
+    save_strategies_to_file(out, strategies)
+    print(f"wrote {len(strategies)} op strategies to {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()
