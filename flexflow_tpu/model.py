@@ -602,6 +602,24 @@ class FFModel:
     # weight access (reference: Parameter::set_weights/get_weights,
     # src/runtime/model.cu:260-370)
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # checkpoint / profiling (runtime/checkpoint.py, runtime/profiling.py)
+    # ------------------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Save full training state (params/stats/optimizer/step)."""
+        from .runtime.checkpoint import save_checkpoint
+        save_checkpoint(self, path)
+
+    def load(self, path: str) -> None:
+        """Restore state saved by ``save``, re-sharded onto this mesh."""
+        from .runtime.checkpoint import load_checkpoint
+        load_checkpoint(self, path)
+
+    def print_op_profile(self) -> None:
+        """Per-op fwd/bwd ms (reference --profiling printouts)."""
+        from .runtime.profiling import print_op_profile
+        print_op_profile(self)
+
     def get_parameter(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
         return np.asarray(self._params[op_name][weight_name])
 

@@ -1,0 +1,70 @@
+"""Profiling / tracing hooks.
+
+Reference instrumentation (SURVEY §5.1): per-op cudaEvent timers behind
+``--profiling`` (conv_2d.cu:448-473) and the Legion profiler via
+``-lg:prof`` CLI flags.  TPU-native equivalents:
+
+  * ``trace(logdir)`` — context manager around ``jax.profiler`` traces:
+    the XLA/TensorBoard profile is the ``-lg:prof`` analogue (kernel
+    timeline, HBM traffic, ICI collectives),
+  * ``op_profile(model)`` — per-op forward/backward wall times, measured
+    by compiling and timing each op standalone on the real device, the
+    way the reference's ``measure_compute_time`` does per-op benchmarks;
+    printed like the reference's per-op ``--profiling`` printouts,
+  * ``annotate(name)`` — TraceAnnotation for custom regions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/flexflow_tpu_trace"):
+    """Capture an XLA profiler trace (view with TensorBoard)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region in the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def op_profile(model, which: str = "both") -> Dict[str, Dict[str, float]]:
+    """Measure each op's standalone fwd (and bwd) time on the real device.
+
+    Uses the simulator's measuring cost model (the measure_compute_time
+    analogue) with per-op sub-shapes from the op's resolved strategy.
+    Returns {op_name: {"forward_ms": x, "backward_ms": y}}.
+    """
+    from ..simulator.cost_model import CostModel
+    from ..simulator.machine import TPUMachineModel
+
+    cm = CostModel(TPUMachineModel(num_devices=model.machine.num_devices),
+                   measure=True)
+    out: Dict[str, Dict[str, float]] = {}
+    for op in model.ops:
+        pc = getattr(op, "pc", None)
+        entry = {}
+        if which in ("both", "forward"):
+            entry["forward_ms"] = cm.op_time(op, pc, "forward") * 1e3
+        if which in ("both", "backward"):
+            entry["backward_ms"] = cm.op_time(op, pc, "backward") * 1e3
+        out[op.name] = entry
+    return out
+
+
+def print_op_profile(model) -> None:
+    """Reference-style per-op ms printout (conv_2d.cu:448-473 style)."""
+    prof = op_profile(model)
+    for name, t in prof.items():
+        fwd = t.get("forward_ms", 0.0)
+        bwd = t.get("backward_ms", 0.0)
+        print(f"[profiling] {name}: forward {fwd:.3f} ms, backward {bwd:.3f} ms")

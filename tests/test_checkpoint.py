@@ -1,0 +1,113 @@
+"""Checkpoint/resume + profiling hooks.
+
+Beyond-reference subsystem (the reference persists only strategy files,
+SURVEY §5.4): full train-state round-trip through orbax and npz, resume
+continuity, and the per-op profile hook.
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+
+def _small_model(batch=16):
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="float32")
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((batch, 8), nchw=False)
+    t = m.dense(inp, 16, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.softmax(t)
+    m.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+              ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [ff.MetricsType.ACCURACY])
+    m.init_layers(seed=3)
+    return m, inp
+
+
+def _feed(m, inp, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((16, 8), dtype=np.float32)
+    y = rng.integers(0, 4, size=(16, 1), dtype=np.int32)
+    m.set_batch({inp: x}, y)
+
+
+def test_orbax_roundtrip_resume(devices, tmp_path):
+    m, inp = _small_model()
+    _feed(m, inp)
+    for _ in range(3):
+        m.train_iteration()
+    m.sync()
+    ckpt = str(tmp_path / "ckpt")
+    m.save(ckpt)
+    w_saved = m.get_parameter("fc1")
+    step_saved = m._step_count
+
+    # Diverge, then restore.
+    for _ in range(2):
+        m.train_iteration()
+    m.sync()
+    assert not np.allclose(m.get_parameter("fc1"), w_saved)
+    m.load(ckpt)
+    np.testing.assert_allclose(m.get_parameter("fc1"), w_saved)
+    assert m._step_count == step_saved
+
+    # Restored optimizer momentum: one more step must match a fresh model
+    # restored to the same point taking the same step.
+    _feed(m, inp, seed=1)
+    m.train_iteration()
+    m.sync()
+    ref = m.get_parameter("fc1")
+
+    m2, inp2 = _small_model()
+    _feed(m2, inp2, seed=9)
+    m2.train_iteration()  # builds opt state
+    m2.sync()
+    m2.load(ckpt)
+    _feed(m2, inp2, seed=1)
+    m2.train_iteration()
+    m2.sync()
+    np.testing.assert_allclose(m2.get_parameter("fc1"), ref, atol=1e-6)
+
+
+def test_npz_roundtrip(devices, tmp_path):
+    m, inp = _small_model()
+    _feed(m, inp)
+    m.train_iteration()
+    m.sync()
+    path = str(tmp_path / "weights.npz")
+    m.save(path)
+    w = m.get_parameter("fc2")
+    for _ in range(2):
+        m.train_iteration()
+    m.sync()
+    m.load(path)
+    np.testing.assert_allclose(m.get_parameter("fc2"), w)
+
+
+def test_checkpoint_manager_rotation(devices, tmp_path):
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+    m, inp = _small_model()
+    _feed(m, inp)
+    mgr = CheckpointManager(str(tmp_path / "mgr"), max_to_keep=2)
+    for _ in range(4):
+        m.train_iteration()
+        m.sync()
+        mgr.save(m)
+    mgr.wait_until_finished()
+    step = m._step_count
+    m.train_iteration()
+    m.sync()
+    restored = mgr.restore_latest(m)
+    assert restored == step
+    assert m._step_count == step
+    mgr.close()
+
+
+def test_op_profile_reports_all_ops(devices):
+    m, inp = _small_model()
+    prof = __import__("flexflow_tpu.runtime.profiling",
+                      fromlist=["op_profile"]).op_profile(m, which="forward")
+    assert set(prof) == {op.name for op in m.ops}
+    assert all(v["forward_ms"] >= 0 for v in prof.values())
