@@ -23,6 +23,8 @@ PER_CHIP_BASELINE = 375.0  # samples/s/chip parity bar (see module docstring)
 def run(batch_size=256, epochs=3, iters_per_epoch=8, compute_dtype="bfloat16"):
     import jax
 
+    jax.config.update("jax_compilation_cache_dir", "/tmp/flexflow_tpu_jax_cache")
+
     import flexflow_tpu as ff
     from flexflow_tpu.models.alexnet import build_alexnet
 
@@ -36,8 +38,11 @@ def run(batch_size=256, epochs=3, iters_per_epoch=8, compute_dtype="bfloat16"):
     dl = ff.DataLoader.synthetic(model, inp, num_samples=batch_size)
     model.init_layers()
 
-    # Compile + warmup.
+    # Compile + warmup: two steps — the first step's outputs carry
+    # committed shardings the initial arrays lacked, so step two triggers
+    # one more (final) compilation before the shapes/shardings fixpoint.
     dl.next_batch(model)
+    model.train_iteration()
     model.train_iteration()
     model.sync()
 

@@ -90,14 +90,16 @@ class Conv2D(Op):
         x = xs[0]
         kernel = params["kernel"].astype(x.dtype)
         ph, pw = self.padding
+        # No explicit f32 upcast: the MXU accumulates bf16 convs in f32
+        # internally, and a preferred_element_type≠input dtype breaks the
+        # conv transpose (wgrad) rule under jax.grad.
         y = lax.conv_general_dilated(
             x, kernel,
             window_strides=self.stride,
             padding=((ph, ph), (pw, pw)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.groups,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-        ).astype(x.dtype)
+        )
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
         return [apply_activation(y, self.activation)]
