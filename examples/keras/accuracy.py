@@ -1,0 +1,14 @@
+"""Accuracy thresholds asserted by the Keras examples
+(reference: examples/python/keras/accuracy.py).
+
+Thresholds are in percent, checked by the ``VerifyMetrics`` /
+``EpochVerifyMetrics`` callbacks after training.  They are set for the
+bundled datasets (real ones when cached locally, the deterministic
+synthetic stand-ins otherwise) — both are learnable well past these bars.
+"""
+
+class ModelAccuracy:
+    MNIST_MLP = 60.0
+    MNIST_CNN = 60.0
+    CIFAR10_CNN = 30.0
+    REUTERS_MLP = 30.0

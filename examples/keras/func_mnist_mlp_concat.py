@@ -1,0 +1,43 @@
+"""Functional MNIST MLP with concat of parallel towers
+(reference: examples/python/keras/func_mnist_mlp_concat.py).
+
+Two dense towers over the same input, concatenated, then classified —
+exercises the functional Model API and the Concatenate layer.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras import Concatenate, Dense, Input, Model
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+
+
+def top_level_task(num_samples=4096, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    inp = Input(shape=(784,))
+    t1 = Dense(256, activation="relu", name="tower1_dense")(inp)
+    t2 = Dense(256, activation="relu", name="tower2_dense")(inp)
+    merged = Concatenate(axis=1, name="concat")([t1, t2])
+    h = Dense(128, activation="relu", name="dense1")(merged)
+    out = Dense(10, activation="softmax", name="dense2")(h)
+
+    model = Model(inputs=[inp], outputs=out,
+                  config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

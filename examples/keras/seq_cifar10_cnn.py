@@ -1,0 +1,46 @@
+"""Sequential CIFAR-10 CNN (reference: examples/python/keras/
+seq_cifar10_cnn.py).
+
+Two conv blocks then dense head, SGD, sparse CCE; asserts train accuracy
+via EpochVerifyMetrics.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras import (Conv2D, Dense, Flatten, Input, MaxPooling2D,
+                                Sequential)
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+
+
+def top_level_task(num_samples=2048, epochs=4, batch_size=64):
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train[:num_samples].astype(np.float32) / 255.0
+    y_train = np.asarray(y_train)[:num_samples].reshape(-1).astype(np.int32)
+
+    model = Sequential(config=FFConfig(batch_size=batch_size))
+    model.add(Input(shape=(3, 32, 32)))
+    model.add(Conv2D(32, (3, 3), (1, 1), padding=(1, 1), activation="relu",
+                     name="conv1"))
+    model.add(MaxPooling2D((2, 2), (2, 2), name="pool1"))
+    model.add(Conv2D(64, (3, 3), (1, 1), padding=(1, 1), activation="relu",
+                     name="conv2"))
+    model.add(MaxPooling2D((2, 2), (2, 2), name="pool2"))
+    model.add(Flatten(name="flat"))
+    model.add(Dense(256, activation="relu", name="dense1"))
+    model.add(Dense(10, activation="softmax", name="dense2"))
+    model.compile(SGD(lr=0.02), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[EpochVerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

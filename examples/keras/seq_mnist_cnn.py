@@ -1,0 +1,50 @@
+"""Sequential MNIST CNN (reference: examples/python/keras/seq_mnist_cnn.py).
+
+conv32-conv64-pool-flatten-dense128-dense10, SGD, sparse CCE; asserts
+final train accuracy via VerifyMetrics.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras import (Conv2D, Dense, Flatten, Input, MaxPooling2D,
+                                Sequential)
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+
+
+def build(batch_size=64):
+    model = Sequential(config=FFConfig(batch_size=batch_size))
+    model.add(Input(shape=(1, 28, 28)))
+    model.add(Conv2D(32, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+                     activation="relu", name="conv1"))
+    model.add(Conv2D(64, kernel_size=(3, 3), strides=(1, 1), padding=(1, 1),
+                     activation="relu", name="conv2"))
+    model.add(MaxPooling2D(pool_size=(2, 2), strides=(2, 2), name="pool1"))
+    model.add(Flatten(name="flat"))
+    model.add(Dense(128, activation="relu", name="dense1"))
+    model.add(Dense(10, activation="softmax", name="dense2"))
+    return model
+
+
+def top_level_task(num_samples=2048, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 1, 28, 28)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    model = build(batch_size)
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

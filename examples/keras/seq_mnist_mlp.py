@@ -1,0 +1,38 @@
+"""Sequential MNIST MLP (reference: examples/python/keras/seq_mnist_mlp.py).
+
+784 → 512 relu → 512 relu → 10 softmax, SGD, sparse CCE; asserts final
+train accuracy via VerifyMetrics.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras import Dense, Input, Sequential
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+
+
+def top_level_task(num_samples=4096, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    model = Sequential(config=FFConfig(batch_size=batch_size))
+    model.add(Input(shape=(784,)))
+    model.add(Dense(512, activation="relu", name="dense1"))
+    model.add(Dense(512, activation="relu", name="dense2"))
+    model.add(Dense(10, activation="softmax", name="dense3"))
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,47 @@
+"""Reuters topic-classification MLP (reference: examples/python/keras/
+seq_reuters_mlp.py).
+
+Bag-of-words binary matrix over the top-N vocabulary → 512 relu →
+46 softmax; asserts train accuracy via EpochVerifyMetrics.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras import Dense, Input, Sequential
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics
+from flexflow_tpu.keras.datasets import reuters
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+
+
+def to_binary_matrix(seqs, num_words):
+    m = np.zeros((len(seqs), num_words), dtype=np.float32)
+    for i, s in enumerate(seqs):
+        idx = [w for w in s if w < num_words]
+        m[i, idx] = 1.0
+    return m
+
+
+def top_level_task(num_words=1000, num_samples=2048, epochs=8, batch_size=64):
+    (x_train, y_train), _ = reuters.load_data(num_words=num_words)
+    x_train = to_binary_matrix(x_train[:num_samples], num_words)
+    y_train = np.asarray(y_train[:num_samples]).astype(np.int32)
+    num_classes = int(y_train.max()) + 1
+
+    model = Sequential(config=FFConfig(batch_size=batch_size))
+    model.add(Input(shape=(num_words,)))
+    model.add(Dense(512, activation="relu", name="dense1"))
+    model.add(Dense(num_classes, activation="softmax", name="dense2"))
+    model.compile(SGD(lr=0.2), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[EpochVerifyMetrics(ModelAccuracy.REUTERS_MLP)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -9,6 +9,22 @@ Legion tasks + cuDNN kernels.  See SURVEY.md at the repo root for the full
 reference inventory this framework mirrors.
 """
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+    # TPU site plugins force-select their platform at interpreter boot
+    # via jax.config.update, which silently overrides the JAX_PLATFORMS
+    # environment variable (config beats env in jax) — so
+    # ``JAX_PLATFORMS=cpu python examples/...`` would still try to
+    # initialize the TPU backend.  Re-assert an explicit CPU choice.
+    # Only the cpu direction is handled: the site env exports a TPU
+    # value by default, and re-asserting it would clobber test
+    # harnesses that select "cpu" via jax.config after boot.
+    import jax as _jax
+
+    if (_jax.config.jax_platforms or "").split(",")[0] != "cpu":
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from .config import DeviceType, FFConfig, ParallelConfig
 from .initializers import (ConstantInitializer, GlorotUniform, NormInitializer,
                            UniformInitializer, ZeroInitializer)

@@ -57,6 +57,29 @@ class Layer:
         """Build this layer onto the core FFModel; returns output Tensor."""
         raise NotImplementedError
 
+    # Weight transfer between compiled models (reference: the keras
+    # net2net examples built on Parameter::get/set_weights,
+    # src/runtime/model.cu:260-370).  Arrays come back in _add_weight
+    # order (kernel before bias).
+    def get_weights(self, ffmodel):
+        if self.name not in ffmodel._params:
+            return ()  # parameterless layer (Flatten, pooling, ...)
+        return tuple(ffmodel.get_parameter(self.name, w)
+                     for w in ffmodel._params[self.name])
+
+    def set_weights(self, ffmodel, *arrays):
+        if self.name not in ffmodel._params:
+            if arrays:
+                raise ValueError(f"layer {self.name} has no weights, "
+                                 f"got {len(arrays)} arrays")
+            return
+        names = list(ffmodel._params[self.name])
+        if len(arrays) != len(names):
+            raise ValueError(
+                f"layer {self.name} has weights {names}, got {len(arrays)} arrays")
+        for wname, arr in zip(names, arrays):
+            ffmodel.set_parameter(self.name, wname, arr)
+
 
 class Conv2D(Layer):
     _type = "Conv2D"

@@ -92,6 +92,22 @@ class BaseModel:
     def ffmodel(self) -> FFModel:
         return self._ffmodel
 
+    @property
+    def layers(self) -> List[Layer]:
+        """Unique layers in graph order (reference: keras Model.layers)."""
+        if self._output is None:
+            return []
+        seen: List[Layer] = []
+
+        def visit(kt: KTensor):
+            for i in kt.inputs:
+                visit(i)
+            if kt.layer is not None and kt.layer not in seen:
+                seen.append(kt.layer)
+
+        visit(self._output)
+        return seen
+
     def fit(self, x, y, epochs: int = 1, callbacks: Sequence = (),
             batch_size: Optional[int] = None, verbose: bool = True):
         ff = self._ffmodel

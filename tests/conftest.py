@@ -23,6 +23,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration test (deselect with -m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,82 @@
+"""Keras example scripts as integration tests (reference: python/test.sh
+runs every keras example; accuracy asserted by VerifyMetrics inside each
+script — SURVEY.md §4.1)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+
+def test_seq_mnist_mlp():
+    from examples.keras.seq_mnist_mlp import top_level_task
+
+    top_level_task(num_samples=512, epochs=2)
+
+
+def test_seq_mnist_cnn():
+    from examples.keras.seq_mnist_cnn import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_func_mnist_mlp_concat():
+    from examples.keras.func_mnist_mlp_concat import top_level_task
+
+    top_level_task(num_samples=1024, epochs=6)
+
+
+def test_seq_reuters_mlp():
+    from examples.keras.seq_reuters_mlp import top_level_task
+
+    top_level_task(num_samples=1024, epochs=8)
+
+
+@pytest.mark.slow
+def test_seq_cifar10_cnn():
+    from examples.keras.seq_cifar10_cnn import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_net2net_weight_transfer():
+    from examples.keras.seq_mnist_cnn_net2net import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_candle_uno_builds_and_trains():
+    import numpy as np
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.models.candle_uno import build_candle_uno
+    from examples.candle_uno import synthetic_batch
+
+    # Scaled-down towers for test speed; same topology.
+    feature_shapes = {"dose": 1, "cell.rnaseq": 64,
+                      "drug.descriptors": 128, "drug.fingerprints": 96}
+    input_features = {"dose1": "dose", "dose2": "dose",
+                      "cell.rnaseq": "cell.rnaseq",
+                      "drug1.descriptors": "drug.descriptors",
+                      "drug1.fingerprints": "drug.fingerprints"}
+    cfg = ff.FFConfig(batch_size=16)
+    model = ff.FFModel(cfg)
+    inputs, _ = build_candle_uno(model, 16, dense_layers=[32] * 3,
+                                 dense_feature_layers=[32] * 3,
+                                 input_features=input_features,
+                                 feature_shapes=feature_shapes)
+    model.compile(ff.SGDOptimizer(model, lr=0.01),
+                  ff.LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  [ff.MetricsType.MEAN_SQUARED_ERROR])
+    model.init_layers()
+    xs, labels = synthetic_batch(16, input_features, feature_shapes)
+    model.set_batch({inputs[k]: v for k, v in xs.items()}, labels)
+    losses = []
+    for _ in range(20):
+        model.train_iteration()
+        pm = model.get_metrics()
+        losses.append(pm.mse_loss / max(1, pm.train_all))
+        model.reset_metrics()
+    model.sync()
+    assert losses[-1] < losses[0], f"MSE did not decrease: {losses[0]} -> {losses[-1]}"
