@@ -97,16 +97,22 @@ class BaseModel:
         """Unique layers in graph order (reference: keras Model.layers)."""
         if self._output is None:
             return []
-        seen: List[Layer] = []
+        ordered: List[Layer] = []
+        seen_layers = set()
+        visited = set()
 
         def visit(kt: KTensor):
+            if id(kt) in visited:
+                return
+            visited.add(id(kt))
             for i in kt.inputs:
                 visit(i)
-            if kt.layer is not None and kt.layer not in seen:
-                seen.append(kt.layer)
+            if kt.layer is not None and id(kt.layer) not in seen_layers:
+                seen_layers.add(id(kt.layer))
+                ordered.append(kt.layer)
 
         visit(self._output)
-        return seen
+        return ordered
 
     def fit(self, x, y, epochs: int = 1, callbacks: Sequence = (),
             batch_size: Optional[int] = None, verbose: bool = True):

@@ -59,6 +59,7 @@ class FFModel:
         self._guid = itertools.count(100)  # reference op_global_guid starts at 100
         self.ops: List[Op] = []
         self.input_tensors: List[Tensor] = []
+        self._constants: Dict[int, Any] = {}  # guid -> (Tensor, fill value)
         self.label_tensor: Optional[Tensor] = None
         self.machine: Optional[Machine] = None
         self.optimizer = None
@@ -98,6 +99,22 @@ class FFModel:
             dims = (n, h, w, c)
         t = Tensor(dims=dims, dtype=dtype, owner_op=None, name=name)
         self.input_tensors.append(t)
+        return t
+
+    def create_constant(self, dims: Sequence[int], value: float,
+                        name: str = "", dtype: str = DataType.FLOAT,
+                        nchw: bool = True) -> Tensor:
+        """Graph-constant tensor filled with ``value`` (reference:
+        FFModel::create_constant, exercised by tests/PCA/pca.cc:75-78).
+        Materialized inside the traced graph, so XLA constant-folds it
+        into consumers; it never appears in ``set_batch``."""
+        dims = tuple(int(d) for d in dims)
+        if len(dims) == 4 and nchw:
+            n, c, h, w = dims
+            dims = (n, h, w, c)
+        t = Tensor(dims=dims, dtype=dtype, owner_op=None,
+                   name=name or f"const_{len(self._constants)}")
+        self._constants[t.guid] = (t, float(value))
         return t
 
     def _append(self, op: Op) -> Tensor:
@@ -418,6 +435,9 @@ class FFModel:
                     x = jax.lax.with_sharding_constraint(
                         x, self.machine.batch_sharding(deg))
             env[t.guid] = x
+        for t, val in self._constants.values():
+            fill_dtype = jnp.int32 if "int" in t.dtype else cdtype
+            env[t.guid] = jnp.full(t.dims, val, fill_dtype)
         ctx = FwdCtx(training=training, rng=rng, stats_in=stats,
                      stats_out={} if training else None)
         for op in self.ops:

@@ -1,0 +1,115 @@
+"""Offline (no-hardware) parallelization-strategy search.
+
+TPU-native analogue of the reference's standalone simulator binary
+(reference: scripts/simulator.cc — a pure-C++ cost model needing zero
+GPUs/Legion that runs 250k simulated-annealing iterations over per-op
+configs, using analytic/pre-measured costs).  This CLI builds a model
+from the zoo, searches with the analytic roofline cost model over a
+configurable TPU machine shape, and exports the best strategy to a
+protobuf file loadable with ``--import-strategy`` / FFConfig.strategies.
+
+Usage:
+    python -m flexflow_tpu.tools.offline_search alexnet \
+        --devices 16 --budget 2000 --export /tmp/alexnet_16.pb
+    python -m flexflow_tpu.tools.offline_search dlrm --devices 8 \
+        --chips-per-host 4 --budget 1000 --export /tmp/dlrm.pb
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+
+def build_model(name: str, batch_size: int, num_devices: int = 1):
+    import flexflow_tpu as ff
+
+    # workers_per_node sizes the simulated machine, not this host's
+    # backend — offline search needs no accelerator at all.
+    cfg = ff.FFConfig(batch_size=batch_size, workers_per_node=num_devices)
+    model = ff.FFModel(cfg)
+    if name == "alexnet":
+        from ..models.alexnet import build_alexnet
+        build_alexnet(model, batch_size)
+    elif name == "resnet":
+        from ..models.resnet import build_resnet50
+        build_resnet50(model, batch_size)
+    elif name == "inception":
+        from ..models.inception import build_inception_v3
+        build_inception_v3(model, batch_size)
+    elif name == "dlrm":
+        from ..models.dlrm import build_dlrm
+        build_dlrm(model, batch_size)
+    elif name == "nmt":
+        from ..models.nmt import build_nmt
+        build_nmt(model, batch_size)
+    elif name == "transformer":
+        from ..models.transformer import build_transformer
+        build_transformer(model, batch_size)
+    elif name == "candle_uno":
+        from ..models.candle_uno import build_candle_uno
+        build_candle_uno(model, batch_size)
+    else:
+        raise SystemExit(f"unknown model {name!r}")
+    return model
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("model", help="alexnet|resnet|inception|dlrm|nmt|"
+                                 "transformer|candle_uno")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--chips-per-host", type=int, default=8)
+    p.add_argument("--ici-bw", type=float, default=45e9,
+                   help="ICI bytes/s per link per direction")
+    p.add_argument("--dcn-bw", type=float, default=25e9,
+                   help="DCN bytes/s per host")
+    p.add_argument("--peak-flops", type=float, default=197e12)
+    p.add_argument("--hbm-bw", type=float, default=819e9)
+    p.add_argument("--budget", type=int, default=1000,
+                   help="MCMC iterations (reference default search budget)")
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--export", default=None, help="strategy .pb output path")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    from ..parallel.strategy import save_strategies_to_file
+    from ..simulator.machine import TPUMachineModel
+    from ..simulator.search import mcmc_search
+    from ..simulator.simulator import Simulator
+    from ..simulator.cost_model import CostModel
+    from ..config import ParallelConfig
+
+    model = build_model(args.model, args.batch_size, args.devices)
+    mm = TPUMachineModel(num_devices=args.devices,
+                         chips_per_host=args.chips_per_host,
+                         peak_flops=args.peak_flops,
+                         hbm_bandwidth=args.hbm_bw,
+                         ici_bandwidth=args.ici_bw,
+                         dcn_bandwidth=args.dcn_bw)
+    sim = Simulator(mm, CostModel(mm, measure=False))
+    dp = {op.name: ParallelConfig.data_parallel(op.output.num_dims, args.devices)
+          .with_device_ids(tuple(range(args.devices)))
+          for op in model.ops}
+    dp_rt = sim.simulate_runtime(model, dp)
+
+    best = mcmc_search(model, budget=args.budget, alpha=args.alpha,
+                       machine_model=mm, measure=False, seed=args.seed,
+                       verbose=not args.quiet)
+    best_rt = sim.simulate_runtime(model, best)
+    speedup = dp_rt / best_rt if best_rt > 0 else float("inf")
+    print(f"data-parallel: {dp_rt * 1e3:.3f} ms/iter; "
+          f"searched: {best_rt * 1e3:.3f} ms/iter; "
+          f"speedup {speedup:.2f}x on {args.devices} chips "
+          f"(torus {mm.torus[0]}x{mm.torus[1]})")
+
+    if args.export:
+        save_strategies_to_file(args.export, best)
+        print(f"exported strategy -> {args.export}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""Standalone per-operator micro-benchmark harness.
+
+TPU-native analogue of the reference's op benchmark rig
+(reference: tests/ops.{h,cu} — a separate Legion binary with its own task
+enum that times individual operators over given shapes).  Here each op is
+built alone on an ``FFModel``, jitted, and timed fwd and fwd+bwd on the
+default backend; prints per-op ms and achieved GFLOP/s.
+
+Usage:
+    python -m flexflow_tpu.tools.opbench                 # standard suite
+    python -m flexflow_tpu.tools.opbench conv2d --batch 64 --in-shape 3,224,224 \
+        --out-channels 64 --kernel 11 --stride 4 --pad 2
+    python -m flexflow_tpu.tools.opbench linear --batch 64 --in-shape 4096 --out-dim 4096
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _build(op_type: str, batch: int, in_shape: Tuple[int, ...], args):
+    """Build a one-op model; returns (model, input tensors, op)."""
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=batch)
+    model = ff.FFModel(cfg)
+    dims = (batch,) + in_shape
+    if op_type == "embedding":
+        x = model.create_tensor(dims, dtype="int32", name="in")
+    else:
+        x = model.create_tensor(dims, name="in")
+    inputs = [x]
+    if op_type == "conv2d":
+        model.conv2d(x, args.out_channels, args.kernel, args.kernel,
+                     args.stride, args.stride, args.pad, args.pad, name="op")
+    elif op_type == "pool2d":
+        model.pool2d(x, args.kernel, args.kernel, args.stride, args.stride,
+                     0, 0, name="op")
+    elif op_type == "linear":
+        model.dense(x, args.out_dim, name="op")
+    elif op_type == "embedding":
+        model.embedding(x, args.num_entries, args.out_dim, name="op")
+    elif op_type == "batch_norm":
+        model.batch_norm(x, relu=False, name="op")
+    elif op_type == "softmax":
+        model.softmax(x, name="op")
+    elif op_type == "flat":
+        model.flat(x, name="op")
+    elif op_type == "concat":
+        y = model.create_tensor(dims, name="in2")
+        inputs.append(y)
+        model.concat([x, y], axis=1, name="op")
+    elif op_type == "add":
+        y = model.create_tensor(dims, name="in2")
+        inputs.append(y)
+        model.add(x, y, name="op")
+    elif op_type == "relu":
+        model.relu(x, name="op")
+    elif op_type == "dropout":
+        model.dropout(x, rate=0.5, name="op")
+    else:
+        raise SystemExit(f"unknown op {op_type!r}")
+    op = model.ops[-1]
+    return model, inputs, op
+
+
+def bench_op(op_type: str, batch: int, in_shape: Tuple[int, ...], args,
+             iters: int = 10) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.base import FwdCtx
+
+    model, inputs, op = _build(op_type, batch, in_shape, args)
+    key = jax.random.key(0)
+    xs = [jnp.zeros(t.dims, jnp.int32 if "int" in t.dtype else jnp.float32)
+          for t in op.inputs]
+    params = {w.name: jnp.zeros(w.dims, jnp.float32) for w in op.weights}
+    stats = op.init_stats()
+    ctx = FwdCtx(training=False, rng=key,
+                 stats_in={op.name: stats} if stats else {})
+
+    def fwd(params, xs):
+        return op.forward(params, list(xs), ctx)[0]
+
+    def loss(params, xs):
+        return jnp.sum(fwd(params, xs).astype(jnp.float32))
+
+    results = {}
+    flops = op.flops_per_sample() * batch
+    for which, fn in (("fwd", jax.jit(fwd)),
+                      ("fwd+bwd", jax.jit(jax.value_and_grad(loss)))):
+        def sync(out):
+            head = out[0] if isinstance(out, tuple) else out
+            jax.device_get(jnp.sum(head.astype(jnp.float32)))
+
+        sync(fn(params, xs))  # compile+warmup
+        # the sync'd call is the iters-th timed call
+        t0 = time.perf_counter()
+        for _ in range(iters - 1):
+            fn(params, xs)
+        sync(fn(params, xs))
+        dt = (time.perf_counter() - t0) / iters
+        eff_flops = flops * (3.0 if which == "fwd+bwd" else 1.0)
+        results[which] = (dt, eff_flops / dt / 1e9 if dt > 0 else 0.0)
+    return results
+
+
+_SUITE = [
+    # (op, batch, in_shape, overrides) — AlexNet/DLRM-flavoured shapes
+    # mirroring the reference harness's coverage.
+    ("conv2d", 64, (3, 224, 224),
+     dict(out_channels=64, kernel=11, stride=4, pad=2)),
+    ("conv2d", 64, (192, 27, 27),
+     dict(out_channels=384, kernel=3, stride=1, pad=1)),
+    ("pool2d", 64, (64, 55, 55), dict(kernel=3, stride=2)),
+    ("linear", 64, (9216,), dict(out_dim=4096)),
+    ("linear", 256, (512,), dict(out_dim=512)),
+    ("embedding", 256, (1,), dict(num_entries=1000000, out_dim=64)),
+    ("batch_norm", 64, (64, 56, 56), {}),
+    ("softmax", 64, (1000,), {}),
+    ("concat", 64, (512,), {}),
+    ("add", 64, (1024,), {}),
+    ("relu", 64, (4096,), {}),
+    ("flat", 64, (256, 6, 6), {}),
+]
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("op", nargs="?", default=None,
+                   help="op to bench (default: standard suite)")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--in-shape", default="3,224,224",
+                   help="comma-separated input shape without batch dim")
+    p.add_argument("--out-channels", type=int, default=64)
+    p.add_argument("--kernel", type=int, default=3)
+    p.add_argument("--stride", type=int, default=1)
+    p.add_argument("--pad", type=int, default=0)
+    p.add_argument("--out-dim", type=int, default=4096)
+    p.add_argument("--num-entries", type=int, default=1000000)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args(argv)
+
+    if args.op:
+        shape = tuple(int(v) for v in args.in_shape.split(","))
+        jobs = [(args.op, args.batch, shape, {})]
+    else:
+        jobs = _SUITE
+
+    print(f"{'op':12s} {'shape':22s} {'fwd ms':>9s} {'GF/s':>8s} "
+          f"{'fwd+bwd ms':>11s} {'GF/s':>8s}")
+    for op_type, batch, in_shape, over in jobs:
+        job_args = argparse.Namespace(**{**vars(args), **over})
+        r = bench_op(op_type, batch, in_shape, job_args, iters=args.iters)
+        f_ms, f_gf = r["fwd"]
+        b_ms, b_gf = r["fwd+bwd"]
+        shape_s = "x".join(str(s) for s in (batch,) + in_shape)
+        print(f"{op_type:12s} {shape_s:22s} {f_ms * 1e3:9.3f} {f_gf:8.1f} "
+              f"{b_ms * 1e3:11.3f} {b_gf:8.1f}")
+
+
+if __name__ == "__main__":
+    main()
