@@ -1,0 +1,254 @@
+"""Native (C++) strategy search: candidate enumeration + marshalling.
+
+The annealing loop, task-graph construction, and event simulation run in
+native/ffsearch.cpp (the analogue of the reference's pure-C++ offline
+searcher, scripts/simulator.cc:1420-1472).  This module enumerates each
+op's legal SOAP candidate configs with analytic costs and partition
+rectangles, flattens everything to arrays, and drives the engine via
+ctypes.  Falls back to the Python ``mcmc_search`` when the library is
+unavailable or the graph uses features the native path doesn't cover
+(multi-output ops).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import ParallelConfig
+from .cost_model import CostModel
+from .machine import TPUMachineModel
+from .search import _SPLITTABLE, _divisors
+
+
+def _factorizations(n: int, dims_avail: List[int], out_dims) -> List[Tuple[int, ...]]:
+    """All assignments of factor ``n`` over ``dims_avail`` that divide the
+    tensor dims; returns full-rank degree tuples."""
+    rank = len(out_dims)
+    results = []
+
+    def rec(rem: int, idx: int, degrees: List[int]):
+        if rem == 1:
+            results.append(tuple(degrees))
+            return
+        if idx >= len(dims_avail):
+            return
+        d = dims_avail[idx]
+        for f in _divisors(rem):
+            if out_dims[d] % f == 0:
+                degrees[d] = f
+                rec(rem // f, idx + 1, degrees)
+        degrees[d] = 1
+
+    rec(n, 0, [1] * rank)
+    return results
+
+
+def enumerate_candidates(op, nd: int) -> List[ParallelConfig]:
+    """Deterministic enumeration of the same SOAP space the Python
+    search samples randomly (search.py random_parallel_config), plus
+    block-aligned placements for sub-machine configs."""
+    rank = op.output.num_dims
+    splittable = [d for d in _SPLITTABLE.get(op._type, (0,))
+                  if d < rank]
+    seen = set()
+    cands: List[ParallelConfig] = []
+    for n in _divisors(nd):
+        for degrees in _factorizations(n, splittable, op.output.dims):
+            parts = int(np.prod(degrees))
+            for off in range(0, nd - parts + 1, parts):
+                ids = tuple(range(off, off + parts))
+                key = (degrees, ids)
+                if key in seen:
+                    continue
+                seen.add(key)
+                cands.append(ParallelConfig(dims=degrees).with_device_ids(ids))
+    return cands
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    from ..utils.native import _load
+
+    lib = _load("libffsearch.so")
+    if lib is not None and not getattr(lib, "_ff_configured", False):
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.ffsearch_anneal.restype = ctypes.c_double
+        lib.ffsearch_anneal.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_double,
+            ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+            i32p, i32p, i32p, i32p,
+            i32p, i32p, f64p, f64p, i64p, i64p, i64p, i64p, i64p, i64p,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_uint64, ctypes.c_int32,
+            i32p, i32p, f64p,
+        ]
+        lib._ff_configured = True
+    return lib
+
+
+def _as(arr, dtype):
+    return np.ascontiguousarray(arr, dtype=dtype)
+
+
+def _ptr(a, ct):
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def native_mcmc_search(model, budget: int, alpha: float = 0.05,
+                       machine_model: Optional[TPUMachineModel] = None,
+                       seed: int = 0, overlap: bool = False,
+                       verbose: bool = True):
+    """Returns (best strategies dict, best simulated runtime, dp runtime)
+    or None when the native engine can't handle this graph."""
+    lib = native_lib()
+    if lib is None:
+        return None
+    ops = model.ops
+    if any(len(op.outputs) != 1 for op in ops):
+        return None
+
+    nd = machine_model.num_devices if machine_model else model.config.num_devices
+    mm = machine_model or TPUMachineModel(num_devices=nd)
+    cost = CostModel(mm, measure=False)
+
+    L = len(ops)
+    op_index = {id(op): i for i, op in enumerate(ops)}
+    max_inputs = max(1, max(len(op.inputs) for op in ops))
+    max_weights = max(1, max(len(op.weights) for op in ops))
+
+    num_inputs = np.zeros(L, np.int32)
+    num_weights = np.zeros(L, np.int32)
+    in_rank = np.zeros(L * max_inputs, np.int32)
+    producer = np.full(L * max_inputs, -1, np.int32)
+    w_rank = np.zeros(L * max_weights, np.int32)
+    out_rank = np.zeros(L, np.int32)
+
+    cand_lists: List[List[ParallelConfig]] = []
+    for i, op in enumerate(ops):
+        num_inputs[i] = len(op.inputs)
+        num_weights[i] = len(op.weights)
+        out_rank[i] = op.output.num_dims
+        for j, tin in enumerate(op.inputs):
+            pre = tin.owner_op
+            producer[i * max_inputs + j] = (
+                op_index.get(id(pre), -1) if pre is not None else -1)
+        cands = enumerate_candidates(op, nd)
+        cands = [model._legalize_pc(op, pc) if hasattr(model, "_legalize_pc")
+                 else pc for pc in cands]
+        # dedupe post-legalization, keep dp (full split of batch) first-known
+        uniq, seen = [], set()
+        for pc in cands:
+            key = (pc.dims, pc.device_ids[:pc.num_parts()])
+            if key not in seen:
+                seen.add(key)
+                uniq.append(pc)
+        cand_lists.append(uniq)
+
+    # rect/dev pools
+    rects: List[int] = []
+    devices: List[int] = []
+    parts_l, fwd_l, bwd_l = [], [], []
+    dev_off, out_off = [], []
+    in_rect_off = []
+    w_tile_off = []
+    cand_off = [0]
+    choice_init = np.zeros(L, np.int32)
+
+    def push_rects(rect_list) -> int:
+        off = len(rects)
+        for rect in rect_list:
+            for lo, hi in rect:
+                rects.append(int(lo))
+                rects.append(int(hi))
+        return off
+
+    for i, op in enumerate(ops):
+        cands = cand_lists[i]
+        dp = ParallelConfig.data_parallel(op.output.num_dims, nd)
+        dp = model._legalize_pc(op, dp) if hasattr(model, "_legalize_pc") else dp
+        init_idx = 0
+        for ci, pc in enumerate(cands):
+            if pc.dims == dp.dims:
+                init_idx = ci
+                break
+        choice_init[i] = init_idx
+        for ci, pc in enumerate(cands):
+            P = pc.num_parts()
+            ids = list(pc.device_ids[:P])
+            if len(ids) < P:
+                ids = list(range(P))
+            parts_l.append(P)
+            fwd_l.append(cost.op_time(op, pc, "forward"))
+            bwd_l.append(cost.op_time(op, pc, "backward"))
+            dev_off.append(len(devices))
+            devices.extend(ids)
+            out_off.append(push_rects(
+                [op.output_tile(pc, p) for p in range(P)]))
+            for j in range(max_inputs):
+                if j < len(op.inputs):
+                    rlist = [op.input_ranges(j, pc, p) for p in range(P)]
+                    if ci == 0:
+                        in_rank[i * max_inputs + j] = len(rlist[0])
+                    in_rect_off.append(push_rects(rlist))
+                else:
+                    in_rect_off.append(0)
+            for w in range(max_weights):
+                if w < len(op.weights):
+                    tlist = [op.weight_tile(pc, w, p) for p in range(P)]
+                    if ci == 0:
+                        w_rank[i * max_weights + w] = len(tlist[0])
+                    w_tile_off.append(push_rects(tlist))
+                else:
+                    w_tile_off.append(0)
+        cand_off.append(cand_off[-1] + len(cands))
+
+    choice_out = np.zeros(L, np.int32)
+    dp_rt = ctypes.c_double(0.0)
+    a_num_inputs = _as(num_inputs, np.int32)
+    a_num_weights = _as(num_weights, np.int32)
+    a_in_rank = _as(in_rank, np.int32)
+    a_producer = _as(producer, np.int32)
+    a_w_rank = _as(w_rank, np.int32)
+    a_out_rank = _as(out_rank, np.int32)
+    a_cand_off = _as(cand_off, np.int32)
+    a_parts = _as(parts_l, np.int32)
+    a_fwd = _as(fwd_l, np.float64)
+    a_bwd = _as(bwd_l, np.float64)
+    a_devices = _as(devices if devices else [0], np.int64)
+    a_dev_off = _as(dev_off, np.int64)
+    a_rects = _as(rects if rects else [0], np.int64)
+    a_out_off = _as(out_off, np.int64)
+    a_in_rect_off = _as(in_rect_off, np.int64)
+    a_w_tile_off = _as(w_tile_off if w_tile_off else [0], np.int64)
+    a_choice_init = _as(choice_init, np.int32)
+    a_choice_out = _as(choice_out, np.int32)
+
+    best_rt = lib.ffsearch_anneal(
+        mm.num_devices, mm.chips_per_host, mm.torus[0], mm.torus[1],
+        mm.ici_bandwidth, mm.dcn_bandwidth,
+        L, _ptr(a_num_inputs, ctypes.c_int32),
+        _ptr(a_num_weights, ctypes.c_int32),
+        max_inputs, max_weights,
+        _ptr(a_in_rank, ctypes.c_int32), _ptr(a_producer, ctypes.c_int32),
+        _ptr(a_w_rank, ctypes.c_int32), _ptr(a_out_rank, ctypes.c_int32),
+        _ptr(a_cand_off, ctypes.c_int32), _ptr(a_parts, ctypes.c_int32),
+        _ptr(a_fwd, ctypes.c_double), _ptr(a_bwd, ctypes.c_double),
+        _ptr(a_devices, ctypes.c_int64), _ptr(a_dev_off, ctypes.c_int64),
+        _ptr(a_rects, ctypes.c_int64), _ptr(a_out_off, ctypes.c_int64),
+        _ptr(a_in_rect_off, ctypes.c_int64),
+        _ptr(a_w_tile_off, ctypes.c_int64),
+        budget, alpha, seed, 1 if overlap else 0,
+        _ptr(a_choice_init, ctypes.c_int32),
+        _ptr(a_choice_out, ctypes.c_int32), ctypes.byref(dp_rt))
+
+    best = {op.name: cand_lists[i][int(a_choice_out[i])]
+            for i, op in enumerate(ops)}
+    if verbose:
+        print(f"native search: dp {dp_rt.value * 1e3:.3f} ms/iter -> "
+              f"best {best_rt * 1e3:.3f} ms/iter over {cand_off[-1]} "
+              f"candidates, budget {budget}")
+    return best, float(best_rt), float(dp_rt.value)

@@ -72,6 +72,8 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--alpha", type=float, default=0.05)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--export", default=None, help="strategy .pb output path")
+    p.add_argument("--engine", choices=["native", "python"], default="native",
+                   help="native C++ annealing engine (falls back to python)")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -95,9 +97,19 @@ def main(argv: Optional[List[str]] = None):
           for op in model.ops}
     dp_rt = sim.simulate_runtime(model, dp)
 
-    best = mcmc_search(model, budget=args.budget, alpha=args.alpha,
-                       machine_model=mm, measure=False, seed=args.seed,
-                       verbose=not args.quiet)
+    best = None
+    if args.engine == "native":
+        from ..simulator.native_search import native_mcmc_search
+
+        r = native_mcmc_search(model, budget=args.budget, alpha=args.alpha,
+                               machine_model=mm, seed=args.seed,
+                               verbose=not args.quiet)
+        if r is not None:
+            best = r[0]
+    if best is None:
+        best = mcmc_search(model, budget=args.budget, alpha=args.alpha,
+                           machine_model=mm, measure=False, seed=args.seed,
+                           verbose=not args.quiet)
     best_rt = sim.simulate_runtime(model, best)
     speedup = dp_rt / best_rt if best_rt > 0 else float("inf")
     print(f"data-parallel: {dp_rt * 1e3:.3f} ms/iter; "

@@ -1,0 +1,386 @@
+// Native strategy-search engine: task-graph construction + event
+// simulation + simulated annealing, entirely in C++.
+//
+// TPU-native counterpart of the reference's offline strategy searcher
+// (reference: scripts/simulator.cc — a pure-C++ cost model + 250k-iteration
+// simulated-annealing loop needing no accelerator), generalized from its
+// NMT-specific graph to any op graph.  Python enumerates the legal
+// candidate ParallelConfigs per op (with per-candidate analytic fwd/bwd
+// costs and partition rectangles) and flattens them into arrays; this
+// engine then proposes/evaluates candidate assignments at native speed —
+// each evaluation rebuilds the task graph (compute tasks, inter-part comm
+// from rectangle intersections, bulk-sync weight allreduce groups) and
+// runs the priority-queue event simulation, mirroring
+// flexflow_tpu/simulator/simulator.py task for task.
+//
+// Build: make -C native   (produces libffsearch.so)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- machine
+struct Machine {
+  int32_t num_devices;
+  int32_t chips_per_host;
+  int32_t torus_x, torus_y;
+  double ici_bw, dcn_bw;
+
+  int hops(int a, int b) const {
+    if (a == b) return 0;
+    int ax = a % torus_x, ay = a / torus_x;
+    int bx = b % torus_x, by = b / torus_x;
+    int dx = std::abs(ax - bx), dy = std::abs(ay - by);
+    dx = std::min(dx, torus_x - dx);
+    dy = std::min(dy, torus_y - dy);
+    return dx + dy;
+  }
+  bool same_host(int a, int b) const {
+    return a / chips_per_host == b / chips_per_host;
+  }
+  double transfer_time(int a, int b, double bytes) const {
+    if (a == b || bytes <= 0) return 0.0;
+    if (same_host(a, b))
+      return bytes * std::max(1, hops(a, b)) / ici_bw;
+    return bytes / dcn_bw;
+  }
+  double allreduce_time(const std::vector<int>& devs, double bytes) const {
+    std::vector<int> u(devs);
+    std::sort(u.begin(), u.end());
+    u.erase(std::unique(u.begin(), u.end()), u.end());
+    size_t n = u.size();
+    if (n <= 1 || bytes <= 0) return 0.0;
+    double bw = ici_bw;
+    for (size_t i = 1; i < n; i++)
+      if (!same_host(u[0], u[i])) { bw = dcn_bw; break; }
+    return 2.0 * double(n - 1) / double(n) * bytes / bw;
+  }
+};
+
+// ------------------------------------------------------- flattened model
+// Rectangles are [lo, hi] int64 pairs, rank pairs per rect.
+struct Candidate {
+  int32_t parts;
+  const int32_t* devices;            // [parts]
+  double fwd_cost, bwd_cost;
+  const int64_t* out_tiles;          // [parts][out_rank][2]
+  // per input j: rects [parts][in_rank_j][2], laid out input-major
+  std::vector<const int64_t*> in_rects;
+  // per weight w: rects [parts][w_rank_w][2]
+  std::vector<const int64_t*> w_tiles;
+};
+
+struct OpDesc {
+  int32_t out_rank;
+  std::vector<int32_t> in_rank;      // rank of each input's rects
+  std::vector<int32_t> w_rank;       // rank of each weight tile
+  std::vector<int32_t> producer;     // producing op index per input, -1 if graph input
+  std::vector<Candidate> cands;
+};
+
+int64_t intersect(const int64_t* ra, const int64_t* rb, int rank) {
+  int64_t vol = 1;
+  for (int d = 0; d < rank; d++) {
+    int64_t lo = std::max(ra[2 * d], rb[2 * d]);
+    int64_t hi = std::min(ra[2 * d + 1], rb[2 * d + 1]);
+    if (hi < lo) return 0;
+    vol *= hi - lo + 1;
+  }
+  return vol;
+}
+
+// ------------------------------------------------------------ simulation
+struct Sim {
+  const Machine* m;
+  const std::vector<OpDesc>* ops;
+  bool overlap;
+
+  // scratch (reused across evaluations)
+  std::vector<double> run_time;
+  std::vector<int64_t> device;   // chip id >= 0; links < 0; barrier uses chip
+  std::vector<int32_t> edge_src, edge_dst;
+
+  int add_task(double rt, int64_t dev) {
+    run_time.push_back(rt);
+    device.push_back(dev);
+    return int(run_time.size()) - 1;
+  }
+  void add_edge(int a, int b) {
+    edge_src.push_back(a);
+    edge_dst.push_back(b);
+  }
+  int64_t link_key(int a, int b) const {
+    int lo = std::min(a, b), hi = std::max(a, b);
+    return -(int64_t(lo) * m->num_devices + hi + 1);
+  }
+  void xfer(int src_task, int dst_task, int a, int b, int64_t vol) {
+    if (vol <= 0) return;
+    if (a == b) { add_edge(src_task, dst_task); return; }
+    double tt = m->transfer_time(a, b, 4.0 * double(vol));
+    int c = add_task(tt, link_key(a, b));
+    add_edge(src_task, c);
+    add_edge(c, dst_task);
+  }
+
+  double evaluate(const std::vector<int32_t>& choice) {
+    run_time.clear(); device.clear(); edge_src.clear(); edge_dst.clear();
+    const auto& O = *ops;
+    size_t L = O.size();
+    // fwd/bwd task ids per (op, part)
+    std::vector<std::vector<int>> fwd(L), bwd(L);
+    for (size_t i = 0; i < L; i++) {
+      const Candidate& c = O[i].cands[choice[i]];
+      fwd[i].resize(c.parts);
+      bwd[i].resize(c.parts);
+      for (int p = 0; p < c.parts; p++) {
+        int dev = c.devices[p] % m->num_devices;
+        fwd[i][p] = add_task(c.fwd_cost, dev);
+        bwd[i][p] = add_task(c.bwd_cost, dev);
+        add_edge(fwd[i][p], bwd[i][p]);
+      }
+    }
+    // data deps + comm
+    for (size_t i = 0; i < L; i++) {
+      const OpDesc& od = O[i];
+      const Candidate& c = od.cands[choice[i]];
+      for (size_t j = 0; j < od.producer.size(); j++) {
+        int pi = od.producer[j];
+        if (pi < 0) continue;
+        const Candidate& pcand = O[pi].cands[choice[pi]];
+        int rank = od.in_rank[j];
+        const int64_t* dst_rects = c.in_rects[j];
+        const int64_t* src_rects = pcand.out_tiles;
+        for (int dp = 0; dp < c.parts; dp++) {
+          const int64_t* dr = dst_rects + size_t(dp) * rank * 2;
+          int ddev = c.devices[dp] % m->num_devices;
+          for (int sp = 0; sp < pcand.parts; sp++) {
+            const int64_t* sr = src_rects + size_t(sp) * rank * 2;
+            int64_t vol = intersect(dr, sr, rank);
+            if (vol > 0) {
+              int sdev = pcand.devices[sp] % m->num_devices;
+              xfer(fwd[pi][sp], fwd[i][dp], sdev, ddev, vol);
+              xfer(bwd[i][dp], bwd[pi][sp], ddev, sdev, vol);
+            }
+          }
+        }
+      }
+    }
+    // weight sync: bulk-sync barrier per device, then allreduce groups
+    std::vector<int> barrier;
+    if (!overlap) {
+      barrier.resize(m->num_devices);
+      for (int d = 0; d < m->num_devices; d++)
+        barrier[d] = add_task(0.0, d);
+      for (size_t i = 0; i < L; i++) {
+        const Candidate& c = O[i].cands[choice[i]];
+        for (int p = 0; p < c.parts; p++)
+          add_edge(bwd[i][p], barrier[c.devices[p] % m->num_devices]);
+      }
+    }
+    std::vector<char> synched;
+    std::vector<int> group;
+    for (size_t i = 0; i < L; i++) {
+      const OpDesc& od = O[i];
+      const Candidate& c = od.cands[choice[i]];
+      for (size_t w = 0; w < od.w_rank.size(); w++) {
+        int rank = od.w_rank[w];
+        const int64_t* tiles = c.w_tiles[w];
+        synched.assign(c.parts, 0);
+        for (int first = 0; first < c.parts; first++) {
+          if (synched[first]) continue;
+          synched[first] = 1;
+          const int64_t* fr = tiles + size_t(first) * rank * 2;
+          group.clear();
+          group.push_back(first);
+          for (int nxt = first + 1; nxt < c.parts; nxt++) {
+            if (synched[nxt]) continue;
+            if (intersect(fr, tiles + size_t(nxt) * rank * 2, rank) > 0) {
+              synched[nxt] = 1;
+              group.push_back(nxt);
+            }
+          }
+          int64_t vol = 1;
+          for (int d = 0; d < rank; d++) vol *= fr[2 * d + 1] - fr[2 * d] + 1;
+          std::vector<int> gdevs;
+          for (int g : group) gdevs.push_back(c.devices[g] % m->num_devices);
+          double art = m->allreduce_time(gdevs, 4.0 * double(vol));
+          int upd = add_task(art, gdevs[0]);
+          if (!overlap) {
+            std::vector<int> u(gdevs);
+            std::sort(u.begin(), u.end());
+            u.erase(std::unique(u.begin(), u.end()), u.end());
+            for (int d : u) add_edge(barrier[d], upd);
+          } else {
+            for (int g : group) add_edge(bwd[i][g], upd);
+          }
+        }
+      }
+    }
+    return simulate();
+  }
+
+  // priority-queue event simulation (same semantics as ffsim.cpp)
+  double simulate() {
+    int n = int(run_time.size());
+    std::vector<int32_t> counter(n, 0);
+    std::vector<std::vector<int32_t>> next(n);
+    for (size_t e = 0; e < edge_src.size(); e++) {
+      next[edge_src[e]].push_back(edge_dst[e]);
+      counter[edge_dst[e]]++;
+    }
+    struct Q { double ready; int32_t order, idx; };
+    struct Cmp {
+      bool operator()(const Q& a, const Q& b) const {
+        if (a.ready != b.ready) return a.ready > b.ready;
+        return a.order > b.order;
+      }
+    };
+    std::priority_queue<Q, std::vector<Q>, Cmp> pq;
+    std::vector<double> ready_time(n, 0.0);
+    for (int i = 0; i < n; i++)
+      if (counter[i] == 0) pq.push({0.0, i, i});
+    std::unordered_map<int64_t, double> dev_time;
+    double sim_time = 0.0;
+    int processed = 0;
+    while (!pq.empty()) {
+      Q q = pq.top(); pq.pop();
+      int i = q.idx;
+      double& dt = dev_time[device[i]];
+      double start = std::max(dt, ready_time[i]);
+      double end = start + run_time[i];
+      dt = end;
+      sim_time = std::max(sim_time, end);
+      processed++;
+      for (int32_t nx : next[i]) {
+        ready_time[nx] = std::max(ready_time[nx], end);
+        if (--counter[nx] == 0) pq.push({ready_time[nx], nx, nx});
+      }
+    }
+    if (processed != n) return -1.0;  // cycle
+    return sim_time;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Run simulated annealing over flattened candidates.
+//
+// Layout (all arrays little-endian native):
+//   L ops. cand_count[L]; per-op arrays flattened candidate-major via
+//   offsets below.  For op i, candidate c (global index g = cand_off[i]+c):
+//     parts[g], fwd_cost[g], bwd_cost[g]
+//     devices:  dev_off[g] indexes into devices[] ([parts] entries)
+//     out tiles: out_off[g] indexes into rects[] ([parts*out_rank*2])
+//     inputs:  op i has num_inputs[i] inputs; in_rank at in_rank_off[i]..;
+//              producer at same offsets; rect offsets per (g, j) at
+//              in_rect_off[in_off[i]*? ] — laid out per-candidate:
+//              in_rect_off[g * max_inputs + j]
+//     weights: num_weights[i]; w_rank at w_rank_off[i]+w;
+//              w_tile_off[g * max_weights + w]
+//   choice_init[L]: starting candidate per op (data parallel).
+//   Returns best simulated runtime; writes best choice into choice_out[L]
+//   and the initial(dp) runtime into dp_runtime_out.
+double ffsearch_anneal(
+    // machine
+    int32_t num_devices, int32_t chips_per_host, int32_t torus_x,
+    int32_t torus_y, double ici_bw, double dcn_bw,
+    // graph
+    int32_t L, const int32_t* num_inputs, const int32_t* num_weights,
+    int32_t max_inputs, int32_t max_weights,
+    const int32_t* in_rank,    // [L*max_inputs]
+    const int32_t* producer,   // [L*max_inputs]
+    const int32_t* w_rank,     // [L*max_weights]
+    const int32_t* out_rank,   // [L]
+    // candidates
+    const int32_t* cand_off,   // [L+1]
+    const int32_t* parts,      // [G]
+    const double* fwd_cost,    // [G]
+    const double* bwd_cost,    // [G]
+    const int64_t* devices,    // device pool
+    const int64_t* dev_off,    // [G]
+    const int64_t* rects,      // rect pool
+    const int64_t* out_off,    // [G]
+    const int64_t* in_rect_off,   // [G*max_inputs]
+    const int64_t* w_tile_off,    // [G*max_weights]
+    // search
+    int32_t budget, double alpha, uint64_t seed, int32_t overlap,
+    const int32_t* choice_init, int32_t* choice_out, double* dp_runtime_out) {
+  Machine m{num_devices, chips_per_host, torus_x, torus_y, ici_bw, dcn_bw};
+  std::vector<OpDesc> ops(L);
+  // devices pool is int64 in the ABI for alignment simplicity; narrow it.
+  std::vector<int32_t> dev_pool;
+  {
+    int64_t maxoff = 0;
+    for (int32_t i = 0; i < L; i++)
+      for (int32_t c = cand_off[i]; c < cand_off[i + 1]; c++)
+        maxoff = std::max(maxoff, dev_off[c] + parts[c]);
+    dev_pool.resize(size_t(maxoff));
+    for (size_t k = 0; k < dev_pool.size(); k++)
+      dev_pool[k] = int32_t(devices[k]);
+  }
+  for (int32_t i = 0; i < L; i++) {
+    OpDesc& od = ops[i];
+    od.out_rank = out_rank[i];
+    for (int32_t j = 0; j < num_inputs[i]; j++) {
+      od.in_rank.push_back(in_rank[i * max_inputs + j]);
+      od.producer.push_back(producer[i * max_inputs + j]);
+    }
+    for (int32_t w = 0; w < num_weights[i]; w++)
+      od.w_rank.push_back(w_rank[i * max_weights + w]);
+    for (int32_t g = cand_off[i]; g < cand_off[i + 1]; g++) {
+      Candidate c;
+      c.parts = parts[g];
+      c.devices = dev_pool.data() + dev_off[g];
+      c.fwd_cost = fwd_cost[g];
+      c.bwd_cost = bwd_cost[g];
+      c.out_tiles = rects + out_off[g];
+      for (int32_t j = 0; j < num_inputs[i]; j++)
+        c.in_rects.push_back(rects + in_rect_off[size_t(g) * max_inputs + j]);
+      for (int32_t w = 0; w < num_weights[i]; w++)
+        c.w_tiles.push_back(rects + w_tile_off[size_t(g) * max_weights + w]);
+      od.cands.push_back(std::move(c));
+    }
+  }
+
+  Sim sim{&m, &ops, overlap != 0};
+  std::vector<int32_t> current(choice_init, choice_init + L);
+  double cur_rt = sim.evaluate(current);
+  if (dp_runtime_out) *dp_runtime_out = cur_rt;
+  std::vector<int32_t> best(current);
+  double best_rt = cur_rt;
+
+  std::mt19937_64 rng(seed);
+  std::uniform_real_distribution<double> uni(0.0, 1.0);
+  for (int32_t it = 0; it < budget; it++) {
+    int32_t i = int32_t(rng() % uint64_t(L));
+    int32_t ncands = cand_off[i + 1] - cand_off[i];
+    if (ncands <= 1) continue;
+    int32_t prev = current[i];
+    int32_t cand = int32_t(rng() % uint64_t(ncands));
+    if (cand == prev) continue;
+    current[i] = cand;
+    double rt = sim.evaluate(current);
+    if (rt < 0) { current[i] = prev; continue; }  // cycle guard
+    if (rt < best_rt) { best_rt = rt; best = current; }
+    // accept like the reference: always if faster, else annealed
+    // (model.cc:1068-1089 uses exp(-alpha * delta); delta in ms there)
+    if (rt < cur_rt || uni(rng) < std::exp(-alpha * (rt - cur_rt) * 1e3)) {
+      cur_rt = rt;
+    } else {
+      current[i] = prev;
+    }
+  }
+  std::memcpy(choice_out, best.data(), sizeof(int32_t) * size_t(L));
+  return best_rt;
+}
+
+}  // extern "C"
