@@ -287,9 +287,27 @@ class FFModel:
                 cfg.import_strategy_file,
                 reference_order=cfg.import_strategy_reference_order))
         if cfg.search_budget > 0:
-            from .simulator.search import mcmc_search
+            # Native C++ annealing engine when built, Python MCMC otherwise
+            # (reference: compile() launches STRATEGY_SEARCH_TASK,
+            # model.cc:991-999).  Both engines must search the REAL
+            # machine (self.machine, already clamped to this backend)
+            # with the same overlap objective.
+            from .simulator.machine import TPUMachineModel
+            from .simulator.native_search import native_mcmc_search
 
-            best = mcmc_search(self, budget=cfg.search_budget, alpha=cfg.search_alpha)
+            mm = TPUMachineModel(num_devices=self.machine.num_devices)
+            best = None
+            r = native_mcmc_search(self, budget=cfg.search_budget,
+                                   alpha=cfg.search_alpha, machine_model=mm,
+                                   overlap=cfg.search_overlap_backward_update,
+                                   verbose=False)
+            if r is not None:
+                best = r[0]
+            if best is None:
+                from .simulator.search import mcmc_search
+
+                best = mcmc_search(self, budget=cfg.search_budget,
+                                   alpha=cfg.search_alpha, machine_model=mm)
             cfg.strategies.update(best)
 
         # Per-op partition configs (default: data parallel over all devices,

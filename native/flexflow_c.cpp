@@ -47,12 +47,21 @@ bool ensure_init() {
   return true;
 }
 
+// Steals the reference to ``args`` (every call site builds a fresh tuple
+// inline); kwargs stays borrowed.  A NULL ``args`` (failed Py_BuildValue,
+// e.g. from a NULL handle) is reported, not dereferenced.
 PyObject* call(PyObject* obj, const char* method, PyObject* args,
                PyObject* kwargs = nullptr) {
+  if (!args) {
+    PyErr_Print();
+    PyErr_Clear();
+    return nullptr;
+  }
   PyObject* fn = PyObject_GetAttrString(obj, method);
-  if (!fn) { PyErr_Print(); return nullptr; }
+  if (!fn) { PyErr_Print(); Py_DECREF(args); return nullptr; }
   PyObject* res = PyObject_Call(fn, args, kwargs);
   Py_DECREF(fn);
+  Py_DECREF(args);
   if (!res) PyErr_Print();
   return res;
 }
@@ -315,19 +324,141 @@ flexflow_tensor_t flexflow_model_add_concat(flexflow_model_t m, int n,
   return out;
 }
 
+static flexflow_tensor_t binary_op(flexflow_model_t m, const char* method,
+                                   flexflow_tensor_t a, flexflow_tensor_t b,
+                                   const char* name);
+
 flexflow_tensor_t flexflow_model_add_add(flexflow_model_t m,
                                          flexflow_tensor_t a,
                                          flexflow_tensor_t b,
                                          const char* name) {
+  return binary_op(m, "add", a, b, name);
+}
+
+static flexflow_tensor_t binary_op(flexflow_model_t m, const char* method,
+                                   flexflow_tensor_t a, flexflow_tensor_t b,
+                                   const char* name) {
   flexflow_tensor_t out{nullptr};
+  if (!a.impl || !b.impl) return out;  // upstream builder failed
   PyObject* kw = PyDict_New();
   if (name) {
     PyObject* nm = PyUnicode_FromString(name);
     PyDict_SetItemString(kw, "name", nm);
     Py_DECREF(nm);
   }
-  out.impl = call(H(m.impl), "add",
+  out.impl = call(H(m.impl), method,
                   Py_BuildValue("(OO)", H(a.impl), H(b.impl)), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+static flexflow_tensor_t unary_op(flexflow_model_t m, const char* method,
+                                  flexflow_tensor_t input, const char* name) {
+  flexflow_tensor_t out{nullptr};
+  if (!input.impl) return out;  // upstream builder failed
+  PyObject* kw = PyDict_New();
+  if (name) {
+    PyObject* nm = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", nm);
+    Py_DECREF(nm);
+  }
+  out.impl = call(H(m.impl), method, Py_BuildValue("(O)", H(input.impl)), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_subtract(flexflow_model_t m,
+                                              flexflow_tensor_t a,
+                                              flexflow_tensor_t b,
+                                              const char* name) {
+  return binary_op(m, "subtract", a, b, name);
+}
+flexflow_tensor_t flexflow_model_add_multiply(flexflow_model_t m,
+                                              flexflow_tensor_t a,
+                                              flexflow_tensor_t b,
+                                              const char* name) {
+  return binary_op(m, "multiply", a, b, name);
+}
+flexflow_tensor_t flexflow_model_add_divide(flexflow_model_t m,
+                                            flexflow_tensor_t a,
+                                            flexflow_tensor_t b,
+                                            const char* name) {
+  return binary_op(m, "divide", a, b, name);
+}
+flexflow_tensor_t flexflow_model_add_relu(flexflow_model_t m,
+                                          flexflow_tensor_t input,
+                                          const char* name) {
+  return unary_op(m, "relu", input, name);
+}
+flexflow_tensor_t flexflow_model_add_sigmoid(flexflow_model_t m,
+                                             flexflow_tensor_t input,
+                                             const char* name) {
+  return unary_op(m, "sigmoid", input, name);
+}
+flexflow_tensor_t flexflow_model_add_tanh(flexflow_model_t m,
+                                          flexflow_tensor_t input,
+                                          const char* name) {
+  return unary_op(m, "tanh", input, name);
+}
+flexflow_tensor_t flexflow_model_add_elu(flexflow_model_t m,
+                                         flexflow_tensor_t input,
+                                         const char* name) {
+  return unary_op(m, "elu", input, name);
+}
+flexflow_tensor_t flexflow_model_add_exp(flexflow_model_t m,
+                                         flexflow_tensor_t input,
+                                         const char* name) {
+  return unary_op(m, "exp", input, name);
+}
+
+flexflow_tensor_t flexflow_model_add_batch_norm(flexflow_model_t m,
+                                                flexflow_tensor_t input,
+                                                int relu, const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:O}", "relu", relu ? Py_True : Py_False);
+  if (name) {
+    PyObject* nm = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", nm);
+    Py_DECREF(nm);
+  }
+  out.impl = call(H(m.impl), "batch_norm",
+                  Py_BuildValue("(O)", H(input.impl)), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_dropout(flexflow_model_t m,
+                                             flexflow_tensor_t input,
+                                             double rate, int seed,
+                                             const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:i}", "seed", seed);
+  if (name) {
+    PyObject* nm = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", nm);
+    Py_DECREF(nm);
+  }
+  out.impl = call(H(m.impl), "dropout",
+                  Py_BuildValue("(Od)", H(input.impl), rate), kw);
+  Py_DECREF(kw);
+  return out;
+}
+
+flexflow_tensor_t flexflow_model_add_mse_loss(flexflow_model_t m,
+                                              flexflow_tensor_t logits,
+                                              flexflow_tensor_t labels,
+                                              const char* reduction,
+                                              const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:s}", "reduction",
+                               reduction ? reduction : "average");
+  if (name) {
+    PyObject* nm = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", nm);
+    Py_DECREF(nm);
+  }
+  out.impl = call(H(m.impl), "mse_loss",
+                  Py_BuildValue("(OO)", H(logits.impl), H(labels.impl)), kw);
   Py_DECREF(kw);
   return out;
 }
@@ -479,6 +610,127 @@ double flexflow_model_get_accuracy(flexflow_model_t m, int64_t* train_all,
   if (train_correct && tc) *train_correct = PyLong_AsLongLong(tc);
   Py_XDECREF(acc); Py_XDECREF(ta); Py_XDECREF(tc); Py_DECREF(pm);
   return result;
+}
+
+int flexflow_model_train_iteration(flexflow_model_t m) {
+  if (flush_batch_if_ready(m) != 0) return -1;
+  return simple_call(m, "train_iteration");
+}
+
+double flexflow_model_get_metric(flexflow_model_t m, const char* name) {
+  PyObject* pm = call(H(m.impl), "get_metrics", PyTuple_New(0));
+  if (!pm) return -1.0;
+  PyObject* v = PyObject_GetAttrString(pm, name);
+  double result = v ? PyFloat_AsDouble(v) : -1.0;
+  if (PyErr_Occurred()) { PyErr_Print(); result = -1.0; }
+  Py_XDECREF(v);
+  Py_DECREF(pm);
+  return result;
+}
+
+int64_t flexflow_parameter_get_volume(flexflow_model_t m, const char* op_name,
+                                      const char* weight_name) {
+  PyObject* arr = call(H(m.impl), "get_parameter",
+                       Py_BuildValue("(ss)", op_name, weight_name));
+  if (!arr) return -1;
+  PyObject* size = PyObject_GetAttrString(arr, "size");
+  int64_t n = size ? PyLong_AsLongLong(size) : -1;
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    n = -1;
+  }
+  Py_XDECREF(size);
+  Py_DECREF(arr);
+  return n;
+}
+
+int flexflow_model_get_parameter_f32(flexflow_model_t m, const char* op_name,
+                                     const char* weight_name, float* out,
+                                     int64_t count) {
+  PyObject* arr = call(H(m.impl), "get_parameter",
+                       Py_BuildValue("(ss)", op_name, weight_name));
+  if (!arr) return -1;
+  PyObject* flat = call(arr, "astype", Py_BuildValue("(s)", "float32"));
+  Py_DECREF(arr);
+  if (!flat) return -1;
+  PyObject* rav = call(flat, "ravel", PyTuple_New(0));
+  Py_DECREF(flat);
+  if (!rav) return -1;
+  PyObject* lst = call(rav, "tolist", PyTuple_New(0));
+  Py_DECREF(rav);
+  if (!lst) return -1;
+  int64_t n = PyList_Size(lst);
+  int rc = 0;
+  if (n != count) {
+    rc = -1;
+  } else {
+    for (int64_t i = 0; i < n; i++)
+      out[i] = (float)PyFloat_AsDouble(PyList_GET_ITEM(lst, i));
+  }
+  Py_DECREF(lst);
+  return rc;
+}
+
+int flexflow_model_set_parameter_f32(flexflow_model_t m, const char* op_name,
+                                     const char* weight_name,
+                                     const float* data, int64_t count) {
+  PyObject* arr_flat = np_array(data, count, nullptr, 1, 'f');
+  if (!arr_flat) return -1;
+  // reshape to the current parameter's shape
+  PyObject* cur = call(H(m.impl), "get_parameter",
+                       Py_BuildValue("(ss)", op_name, weight_name));
+  if (!cur) { Py_DECREF(arr_flat); return -1; }
+  PyObject* shape = PyObject_GetAttrString(cur, "shape");
+  Py_DECREF(cur);
+  if (!shape) {
+    PyErr_Print();
+    Py_DECREF(arr_flat);
+    return -1;
+  }
+  PyObject* arr = call(arr_flat, "reshape", Py_BuildValue("(O)", shape));
+  Py_DECREF(shape);
+  Py_DECREF(arr_flat);
+  if (!arr) return -1;
+  PyObject* res = call(H(m.impl), "set_parameter",
+                       Py_BuildValue("(ssO)", op_name, weight_name, arr));
+  Py_DECREF(arr);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int flexflow_config_import_strategy(flexflow_config_t c, const char* path) {
+  PyObject* p = PyUnicode_FromString(path);
+  int rc = PyObject_SetAttrString(H(c.impl), "import_strategy_file", p);
+  Py_DECREF(p);
+  return rc;
+}
+
+int flexflow_model_export_strategy(flexflow_model_t m, const char* path) {
+  PyObject* strategies = call(H(m.impl), "get_strategies", PyTuple_New(0));
+  if (!strategies) return -1;
+  PyObject* fn = PyObject_GetAttrString(g_module, "save_strategies_to_file");
+  if (!fn) { Py_DECREF(strategies); PyErr_Print(); return -1; }
+  PyObject* res = PyObject_CallFunction(fn, "sO", path, strategies);
+  Py_DECREF(fn);
+  Py_DECREF(strategies);
+  if (!res) { PyErr_Print(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+int flexflow_model_save(flexflow_model_t m, const char* path) {
+  PyObject* res = call(H(m.impl), "save", Py_BuildValue("(s)", path));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int flexflow_model_load(flexflow_model_t m, const char* path) {
+  PyObject* res = call(H(m.impl), "load", Py_BuildValue("(s)", path));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
 }
 
 int flexflow_tensor_get_dims(flexflow_tensor_t t, int* dims) {

@@ -72,6 +72,45 @@ flexflow_tensor_t flexflow_model_add_add(flexflow_model_t m,
                                          flexflow_tensor_t a,
                                          flexflow_tensor_t b,
                                          const char* name);
+flexflow_tensor_t flexflow_model_add_subtract(flexflow_model_t m,
+                                              flexflow_tensor_t a,
+                                              flexflow_tensor_t b,
+                                              const char* name);
+flexflow_tensor_t flexflow_model_add_multiply(flexflow_model_t m,
+                                              flexflow_tensor_t a,
+                                              flexflow_tensor_t b,
+                                              const char* name);
+flexflow_tensor_t flexflow_model_add_divide(flexflow_model_t m,
+                                            flexflow_tensor_t a,
+                                            flexflow_tensor_t b,
+                                            const char* name);
+flexflow_tensor_t flexflow_model_add_relu(flexflow_model_t m,
+                                          flexflow_tensor_t input,
+                                          const char* name);
+flexflow_tensor_t flexflow_model_add_sigmoid(flexflow_model_t m,
+                                             flexflow_tensor_t input,
+                                             const char* name);
+flexflow_tensor_t flexflow_model_add_tanh(flexflow_model_t m,
+                                          flexflow_tensor_t input,
+                                          const char* name);
+flexflow_tensor_t flexflow_model_add_elu(flexflow_model_t m,
+                                         flexflow_tensor_t input,
+                                         const char* name);
+flexflow_tensor_t flexflow_model_add_exp(flexflow_model_t m,
+                                         flexflow_tensor_t input,
+                                         const char* name);
+flexflow_tensor_t flexflow_model_add_batch_norm(flexflow_model_t m,
+                                                flexflow_tensor_t input,
+                                                int relu, const char* name);
+flexflow_tensor_t flexflow_model_add_dropout(flexflow_model_t m,
+                                             flexflow_tensor_t input,
+                                             double rate, int seed,
+                                             const char* name);
+flexflow_tensor_t flexflow_model_add_mse_loss(flexflow_model_t m,
+                                              flexflow_tensor_t logits,
+                                              flexflow_tensor_t labels,
+                                              const char* reduction,
+                                              const char* name);
 
 /* compile: optimizer "sgd"|"adam"; loss per reference names */
 int flexflow_model_compile(flexflow_model_t m, const char* optimizer,
@@ -97,9 +136,33 @@ int flexflow_model_update(flexflow_model_t m);
 int flexflow_model_sync(flexflow_model_t m);
 void flexflow_model_reset_metrics(flexflow_model_t m);
 
+/* fused train step (staged batch must be set) */
+int flexflow_model_train_iteration(flexflow_model_t m);
+
 /* metrics: returns accuracy %; train_all/correct optional out-params */
 double flexflow_model_get_accuracy(flexflow_model_t m, int64_t* train_all,
                                    int64_t* train_correct);
+/* any PerfMetrics field by name ("accuracy", "cce_loss", "sparse_cce_loss",
+ * "mse_loss", "rmse_loss", "mae_loss", "train_all", "train_correct") */
+double flexflow_model_get_metric(flexflow_model_t m, const char* name);
+
+/* weights (reference: Parameter::get_weights/set_weights) */
+int64_t flexflow_parameter_get_volume(flexflow_model_t m, const char* op_name,
+                                      const char* weight_name);
+int flexflow_model_get_parameter_f32(flexflow_model_t m, const char* op_name,
+                                     const char* weight_name, float* out,
+                                     int64_t count);
+int flexflow_model_set_parameter_f32(flexflow_model_t m, const char* op_name,
+                                     const char* weight_name,
+                                     const float* data, int64_t count);
+
+/* strategy files (reference: --import-strategy / --export-strategy) */
+int flexflow_config_import_strategy(flexflow_config_t c, const char* path);
+int flexflow_model_export_strategy(flexflow_model_t m, const char* path);
+
+/* checkpoint / resume */
+int flexflow_model_save(flexflow_model_t m, const char* path);
+int flexflow_model_load(flexflow_model_t m, const char* path);
 
 /* tensor introspection */
 int flexflow_tensor_get_dims(flexflow_tensor_t t, int* dims /*>=4 slots*/);

@@ -56,9 +56,58 @@ int main(void) {
   printf("C API accuracy: %.2f%% (%lld/%lld)\n", acc, (long long)correct,
          (long long)all);
   assert(acc > 60.0);
+  assert(flexflow_model_get_metric(model, "sparse_cce_loss") >= 0.0);
+
+  /* fused train step via the staged batch */
+  assert(flexflow_model_set_input_f32(model, input, x, 32 * 8) == 0);
+  assert(flexflow_model_set_label_i32(model, y, 32) == 0);
+  assert(flexflow_model_train_iteration(model) == 0);
+  assert(flexflow_model_sync(model) == 0);
+
+  /* parameter get/set round-trip (reference: Parameter::get/set_weights) */
+  int64_t vol = flexflow_parameter_get_volume(model, "fc1", "kernel");
+  assert(vol == 8 * 32);
+  float* w = (float*)malloc(sizeof(float) * vol);
+  assert(flexflow_model_get_parameter_f32(model, "fc1", "kernel", w, vol) == 0);
+  w[0] += 1.0f;
+  assert(flexflow_model_set_parameter_f32(model, "fc1", "kernel", w, vol) == 0);
+  float* w2 = (float*)malloc(sizeof(float) * vol);
+  assert(flexflow_model_get_parameter_f32(model, "fc1", "kernel", w2, vol) == 0);
+  assert(w2[0] > w[0] - 1e-3f && w2[0] < w[0] + 1e-3f);
+  free(w);
+  free(w2);
+
+  /* strategy export */
+  assert(flexflow_model_export_strategy(model, "/tmp/capi_strategy.pb") == 0);
+
+  /* checkpoint save/load round-trip */
+  assert(flexflow_model_save(model, "/tmp/capi_ckpt.npz") == 0);
+  assert(flexflow_model_load(model, "/tmp/capi_ckpt.npz") == 0);
 
   flexflow_model_destroy(model);
   flexflow_config_destroy(cfg);
+
+  /* elementwise builders compile into a second graph */
+  flexflow_config_t cfg2 = flexflow_config_create(8, 1, 0);
+  flexflow_model_t m2 = flexflow_model_create(cfg2);
+  int d2[2] = {8, 16};
+  flexflow_tensor_t a = flexflow_tensor_create(m2, 2, d2, "float32");
+  flexflow_tensor_t b = flexflow_tensor_create(m2, 2, d2, "float32");
+  flexflow_tensor_t s = flexflow_model_add_subtract(m2, a, b, NULL);
+  s = flexflow_model_add_multiply(m2, s, b, NULL);
+  s = flexflow_model_add_relu(m2, s, NULL);
+  s = flexflow_model_add_tanh(m2, s, NULL);
+  s = flexflow_model_add_dense(m2, s, 4, 0, 1, "head");
+  s = flexflow_model_add_softmax(m2, s, NULL);
+  assert(s.impl != NULL);
+  const char* mets2[] = {"accuracy"};
+  assert(flexflow_model_compile(m2, "adam", 0.001,
+                                "sparse_categorical_crossentropy", mets2,
+                                1) == 0);
+  assert(flexflow_model_init_layers(m2) == 0);
+  flexflow_model_destroy(m2);
+  flexflow_config_destroy(cfg2);
+
   printf("C API smoke test: OK\n");
   return 0;
 }
