@@ -60,6 +60,8 @@ class FFModel:
         self.ops: List[Op] = []
         self.input_tensors: List[Tensor] = []
         self._constants: Dict[int, Any] = {}  # guid -> (Tensor, fill value)
+        self._offload: Dict[Tuple[str, str], Any] = {}  # host-offloaded weights
+        self._offload_warned = False
         self.label_tensor: Optional[Tensor] = None
         self.machine: Optional[Machine] = None
         self.optimizer = None
@@ -352,7 +354,8 @@ class FFModel:
                 changed = True
         if not changed:
             return pc
-        npc = ParallelConfig(pc.device_type, tuple(dims))
+        npc = ParallelConfig(pc.device_type, tuple(dims),
+                             memory_types=pc.memory_types)
         return npc.with_device_ids(tuple(range(npc.num_parts())))
 
     def _all_strategies(self) -> Dict[str, ParallelConfig]:
@@ -376,6 +379,7 @@ class FFModel:
     # ------------------------------------------------------------------
     def _param_spec_tree(self) -> Dict[str, Dict[str, NamedSharding]]:
         out: Dict[str, Dict[str, NamedSharding]] = {}
+        self._offload: Dict[Tuple[str, str], Tuple[NamedSharding, NamedSharding]] = {}
         for op in self.ops:
             if not op.weights:
                 continue
@@ -394,9 +398,57 @@ class FFModel:
                         entries.append(g if len(g) > 1 else g[0])
                 while entries and entries[-1] is None:
                     entries.pop()
-                specs[w.name] = NamedSharding(self.machine.mesh, PartitionSpec(*entries))
+                sh = NamedSharding(self.machine.mesh, PartitionSpec(*entries))
+                host_placed = (op.pc.device_type == DeviceType.CPU
+                               or "host" in op.pc.memory_types)
+                if host_placed:
+                    # Heterogeneous placement (reference: ParallelConfig::
+                    # device_type=CPU routes ops to CPU task variants, and
+                    # memory_types ZCM entries pin regions to host
+                    # zero-copy memory, so DLRM keeps huge embedding
+                    # tables off-accelerator — embedding.cc +
+                    # dlrm_strategy_hetero.cc).  TPU equivalent: the
+                    # weight (and its optimizer state) LIVES in pinned
+                    # host memory; each step streams it to device,
+                    # computes, and streams the update back.
+                    try:
+                        host_sh = sh.with_memory_kind("pinned_host")
+                        self._offload[(op.name, w.name)] = (host_sh, sh)
+                        sh = host_sh
+                    except ValueError:
+                        # backend without host memory kinds: keep HBM,
+                        # but say so — silently dropping offload turns
+                        # into an accelerator OOM on real workloads.
+                        if not self._offload_warned:
+                            self._offload_warned = True
+                            print(f"flexflow_tpu: host placement requested "
+                                  f"for {op.name}/{w.name} but this backend "
+                                  f"has no pinned_host memory; keeping "
+                                  f"weights in device memory")
+                specs[w.name] = sh
             out[op.name] = specs
         return out
+
+    def _offload_put(self, tree, to_host: bool):
+        """Move host-offloaded weights between pinned-host and device
+        memory (params-shaped tree; missing entries are left alone)."""
+        if not self._offload:
+            return tree
+        tree = {k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in tree.items()}
+        for (opn, wn), (host_sh, dev_sh) in self._offload.items():
+            if opn in tree and isinstance(tree[opn], dict) and wn in tree[opn]:
+                tree[opn][wn] = jax.device_put(
+                    tree[opn][wn], host_sh if to_host else dev_sh)
+        return tree
+
+    def _offload_put_state(self, state, to_host: bool):
+        """Same as ``_offload_put`` for optimizer state: each value is a
+        params-shaped subtree ("v"/"m"), scalars pass through."""
+        if not self._offload or state is None:
+            return state
+        return {k: self._offload_put(v, to_host) if isinstance(v, dict) else v
+                for k, v in state.items()}
 
     def init_layers(self, seed: Optional[int] = None) -> None:
         assert self._compiled, "call compile() first"
@@ -421,7 +473,15 @@ class FFModel:
                 params[op.name] = p
             return params
 
-        self._params = jax.jit(init_fn, out_shardings=shardings)(key)
+        # Offloaded weights are initialized on device (the SPMD partitioner
+        # rejects host-placement annotations inside this jit) and streamed
+        # to pinned-host memory right after.
+        init_shardings = {opn: {wn: (self._offload[(opn, wn)][1]
+                                     if (opn, wn) in self._offload else sh)
+                                for wn, sh in ws.items()}
+                          for opn, ws in shardings.items()}
+        self._params = jax.jit(init_fn, out_shardings=init_shardings)(key)
+        self._params = self._offload_put(self._params, True)
         self._stats = {}
         for op in self.ops:
             st = op.init_stats()
@@ -430,9 +490,15 @@ class FFModel:
                     st, self.machine.replicated())
         # Optimizer state mirrors the params pytree and inherits each
         # param's sharding (momentum/moment buffers live with their shard).
-        self._opt_state = (self.optimizer.init_state(self._params)
+        self._opt_state = (self._init_opt_state()
                            if self.optimizer is not None else None)
         self._step_count = 0
+
+    def _init_opt_state(self):
+        # zeros_like does not carry memory kinds: pin offloaded entries'
+        # state to host explicitly so every step sees consistent kinds.
+        return self._offload_put_state(self.optimizer.init_state(self._params),
+                                       True)
 
     # ------------------------------------------------------------------
     # forward-graph evaluation (inside jit)
@@ -574,14 +640,22 @@ class FFModel:
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         if self._opt_state is None:
-            self._opt_state = self.optimizer.init_state(self._params)
+            self._opt_state = self._init_opt_state()
         if self._metric_acc is None:
             self._metric_acc = jnp.zeros((len(self._metric_keys()),), jnp.float32)
         hp = self.optimizer.hparams()
-        self._params, self._stats, self._opt_state, self._metric_acc = \
-            self._train_step_fn(self._params, self._stats, self._opt_state,
+        # Host-offloaded weights stream on-chip for the step and back
+        # after (eager device_put at the jit boundary: the reference's
+        # CPU-resident tables likewise live in host memory between
+        # iterations; the step itself computes on the accelerator).
+        params_in = self._offload_put(self._params, False)
+        opt_in = self._offload_put_state(self._opt_state, False)
+        new_params, self._stats, new_opt, self._metric_acc = \
+            self._train_step_fn(params_in, self._stats, opt_in,
                                 hp, self._batch, jnp.uint32(self._step_count),
                                 self._metric_acc)
+        self._params = self._offload_put(new_params, True)
+        self._opt_state = self._offload_put_state(new_opt, True)
         self._step_count += 1
         self._staged = False
 
@@ -595,7 +669,8 @@ class FFModel:
     def eval_batch(self) -> Dict[str, float]:
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
-        msum, _ = self._eval_step_fn(self._params, self._stats, self._batch)
+        msum, _ = self._eval_step_fn(self._offload_put(self._params, False),
+                                     self._stats, self._batch)
         return {k: float(v) for k, v in msum.items()}
 
     # ------------------------------------------------------------------

@@ -1,0 +1,85 @@
+"""Heterogeneous (host-memory) weight placement.
+
+Reference: ParallelConfig::device_type=CPU routes ops to CPU task variants
+so DLRM keeps huge embedding tables in host zero-copy memory
+(embedding.cc:18-77, dlrm_strategy_hetero.cc).  TPU equivalent under test:
+a CPU-typed config pins the op's weights (and optimizer state) in
+pinned-host memory; each step streams them on-chip and back."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.config import DeviceType
+
+
+def _build(offload: bool, momentum: float = 0.9):
+    cfg = ff.FFConfig(batch_size=16)
+    if offload:
+        cfg.strategies["emb"] = ff.ParallelConfig(
+            DeviceType.CPU, (1, 1), (0,))
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((16, 4), dtype="int32", name="ids")
+    t = m.embedding(ids, 100, 8, name="emb")
+    t = m.dense(t, 4, name="head")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(m, lr=0.1, momentum=momentum),
+              ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [ff.MetricsType.ACCURACY])
+    m.init_layers(seed=11)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 100, (16, 4)).astype(np.int32)
+    y = (x[:, 0] % 4).astype(np.int32).reshape(-1, 1)
+    m.set_batch({ids: x}, y)
+    return m
+
+
+def test_offloaded_table_lives_in_host_memory(devices):
+    m = _build(offload=True)
+    w = m._params["emb"]["weight"]
+    assert w.sharding.memory_kind == "pinned_host"
+    assert ("emb", "weight") in m._offload
+
+
+def test_offloaded_training_matches_device_training(devices):
+    m_dev = _build(offload=False)
+    m_host = _build(offload=True)
+    for _ in range(8):
+        m_dev.train_iteration()
+        m_host.train_iteration()
+    m_dev.sync()
+    m_host.sync()
+    np.testing.assert_allclose(m_dev.get_parameter("emb", "weight"),
+                               m_host.get_parameter("emb", "weight"),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(m_dev.get_parameter("head", "kernel"),
+                               m_host.get_parameter("head", "kernel"),
+                               rtol=2e-5, atol=2e-6)
+    # updated table still lives in host memory after training
+    assert m_host._params["emb"]["weight"].sharding.memory_kind == "pinned_host"
+
+
+def test_memory_types_host_triggers_offload(devices):
+    # strategy-file memory_types wire field ("host" = reference ZCM)
+    # must drive placement like device_type=CPU does
+    cfg = ff.FFConfig(batch_size=16)
+    cfg.strategies["emb"] = ff.ParallelConfig(
+        DeviceType.TPU, (1, 1), (0,), memory_types=("host",))
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((16, 4), dtype="int32", name="ids")
+    t = m.embedding(ids, 50, 8, name="emb")
+    t = m.dense(t, 4, name="head")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(m, lr=0.1),
+              ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [ff.MetricsType.ACCURACY])
+    m.init_layers()
+    assert m._params["emb"]["weight"].sharding.memory_kind == "pinned_host"
+
+
+def test_offloaded_momentum_state_in_host_memory(devices):
+    m = _build(offload=True, momentum=0.9)
+    m.train_iteration()
+    m.sync()
+    v = m._opt_state["v"]["emb"]["weight"]
+    assert v.sharding.memory_kind == "pinned_host"

@@ -1,0 +1,43 @@
+"""Resharding between differently-partitioned adjacent ops.
+
+The reference's core magic: op A under config X feeds op B under config Y
+and Legion moves the data (SURVEY.md §7 'hard parts').  Here GSPMD does
+the movement; each pair below trains the test net with a DIFFERENT config
+transition on one edge and must match single-device numerics exactly
+(up to float reassociation)."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from tests.test_sharding import DP8, SINGLE, build_and_train
+
+# (producer name, producer config, consumer name, consumer config) —
+# transitions covering dp→spatial, spatial→dp, dp→tp, tp→dp, tp→tp,
+# sample-split changes, and the 4D→2D flat boundary.
+PAIRS = [
+    ("conv1", (8, 1, 1, 1), "pool1", (2, 2, 2, 1)),   # dp -> spatial
+    ("conv1", (2, 2, 2, 1), "pool1", (8, 1, 1, 1)),   # spatial -> dp
+    ("conv1", (1, 4, 2, 1), "pool1", (4, 1, 1, 1)),   # pure spatial -> dp4
+    ("fc1", (8, 1), "fc2", (2, 4)),                   # dp -> tensor parallel
+    ("fc1", (2, 4), "fc2", (4, 2)),                   # tp -> different tp
+    ("flat1", (2, 1), "fc1", (1, 8)),                 # sample2 -> pure tp
+]
+
+
+@pytest.fixture(scope="module")
+def single_baseline(devices):
+    return build_and_train(SINGLE)[:2]
+
+
+@pytest.mark.parametrize("pair", PAIRS,
+                         ids=[f"{a}{x}->{b}{y}" for a, x, b, y in PAIRS])
+def test_resharding_pair_matches_single_device(devices, single_baseline, pair):
+    prod, pcfg, cons, ccfg = pair
+    strategies = dict(DP8)
+    strategies[prod] = ff.ParallelConfig(dims=pcfg)
+    strategies[cons] = ff.ParallelConfig(dims=ccfg)
+    fc2, conv1, _ = build_and_train(strategies)
+    fc2_a, conv_a = single_baseline
+    np.testing.assert_allclose(fc2_a, fc2, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(conv_a, conv1, rtol=5e-4, atol=5e-5)
