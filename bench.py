@@ -57,8 +57,18 @@ def run(batch_size=256, epochs=3, iters_per_epoch=8, compute_dtype="bfloat16"):
 
 
 def main():
+    import signal
+
+    def _timeout(signum, frame):
+        raise TimeoutError("TPU backend unresponsive (tunnel wedged?)")
+
+    # A wedged TPU tunnel hangs backend init forever; without this the
+    # driver would get NO json line at all.
+    signal.signal(signal.SIGALRM, _timeout)
+    signal.alarm(1200)
     try:
         throughput, n_dev = run()
+        signal.alarm(0)
         per_chip = throughput / max(1, n_dev)
         print(json.dumps({
             "metric": "alexnet_train_samples_per_sec_per_chip",

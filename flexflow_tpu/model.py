@@ -219,6 +219,16 @@ class FFModel:
                 name: Optional[str] = None) -> Tensor:
         return self._append(Dropout(self, input_tensor, rate, seed, name))
 
+    def pipeline_mlp(self, input_tensor: Tensor, num_stages: int,
+                     num_microbatches: int = 4, activation: str = "relu",
+                     name: Optional[str] = None) -> Tensor:
+        """Stack of identical dense stages pipelined over config dim 1
+        (GPipe microbatching; the SOAP Operator-dimension analogue of the
+        reference's per-op GPU placement, nmt/nmt.cc:269-308)."""
+        from .ops.pipeline import PipelineMLP
+        return self._append(PipelineMLP(self, input_tensor, num_stages,
+                                        num_microbatches, activation, name))
+
     def mse_loss(self, logits: Tensor, labels: Tensor,
                  reduction: str = "average", name: Optional[str] = None) -> Tensor:
         return self._append(MSELoss(self, logits, labels, reduction, name))
@@ -529,7 +539,8 @@ class FFModel:
             pvals = params.get(op.param_key, {})
             ys = op.forward(pvals, xs, ctx)
             if multi:
-                ys = [self.machine.constraint(y, op.pc) for y in ys]
+                cpc = op.constraint_pc()
+                ys = [self.machine.constraint(y, cpc) for y in ys]
             for t, y in zip(op.outputs, ys):
                 env[t.guid] = y
         new_stats = dict(stats)

@@ -87,6 +87,12 @@ class Op:
     def forward(self, params: Dict[str, jax.Array], xs: List[jax.Array], ctx: FwdCtx) -> List[jax.Array]:
         raise NotImplementedError
 
+    def constraint_pc(self):
+        """ParallelConfig used to place this op's OUTPUT activations.
+        Defaults to the op's own config; ops whose config dims carry
+        non-layout meaning (e.g. the pipeline degree) override this."""
+        return self.pc
+
     # -- stats (non-trainable state, e.g. batchnorm running moments) -------
     def init_stats(self) -> Dict[str, jax.Array]:
         return {}

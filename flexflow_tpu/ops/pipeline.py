@@ -1,0 +1,94 @@
+"""Pipeline-parallel stacked-dense operator.
+
+SOAP's fourth letter is the Operator dimension; the reference exploits it
+by pinning ops to different GPUs and letting Legion overlap them (the NMT
+encoder/decoder placement, nmt/nmt.cc:269-308).  This op makes the depth
+dimension an explicit partitionable axis: a stack of ``num_stages``
+identical (d → d, activation) dense stages whose ``ParallelConfig``
+dim 1 is the PIPELINE degree — each mesh-axis slice holds consecutive
+stages and activations flow through a GPipe microbatch schedule
+(parallel/pipeline.py).  Degree 1 (or single device) runs the same math
+sequentially, so strategies change placement, not results.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import FwdCtx, Op
+from ..initializers import DefaultWeightInitializer, ZeroInitializer
+
+
+class PipelineMLP(Op):
+    _type = "PipelineMLP"
+
+    def __init__(self, model, input_tensor, num_stages: int,
+                 num_microbatches: int = 4, activation: str = "relu",
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        d = input_tensor.dims[-1]
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.activation = activation
+        # stage (leading) dim partitions over config dim 1 — the pipeline
+        # degree; d×d stages keep one static ring-buffer shape.
+        self._add_weight("kernel", (num_stages, d, d),
+                         DefaultWeightInitializer(),
+                         partition_dims=(1, None, None))
+        self._add_weight("bias", (num_stages, d), ZeroInitializer(),
+                         partition_dims=(1, None))
+        self._add_output(input_tensor.dims)
+
+    def _stage(self, p, h):
+        y = jnp.dot(h, p["kernel"].astype(h.dtype))
+        y = y + p["bias"].astype(y.dtype)
+        if self.activation == "relu":
+            y = jax.nn.relu(y)
+        elif self.activation == "tanh":
+            y = jnp.tanh(y)
+        return y
+
+    def _pipe_degree(self) -> int:
+        pc = getattr(self, "pc", None)
+        if pc is None or len(pc.dims) < 2:
+            return 1
+        return pc.dims[1]
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        x = xs[0]
+        tree = {"kernel": params["kernel"], "bias": params["bias"]}
+        degree = self._pipe_degree()
+        machine = self.model.machine
+        if degree > 1 and machine is not None and machine.num_devices > 1:
+            from ..parallel.pipeline import pipeline_apply
+
+            degrees = list(self.pc.dims) + [1] * (2 - len(self.pc.dims))
+            groups = machine.axes_for_degrees(degrees[:2])
+            batch_axes = groups[0] if groups[0] else None
+            pipe_axes = groups[1]
+            mb = min(self.num_microbatches, x.shape[0])
+            while x.shape[0] % mb != 0:
+                mb -= 1
+            return [pipeline_apply(self._stage, tree, x, machine.mesh,
+                                   pipe_axes, mb, batch_axes=batch_axes)]
+        from ..parallel.pipeline import sequential_stages
+
+        return [sequential_stages(self._stage, tree, x)]
+
+    def constraint_pc(self):
+        # config dim 1 is the pipeline degree, not a feature-dim split:
+        # the output is batch-sharded only (replicated over the pipe axes)
+        from ..config import ParallelConfig
+        return ParallelConfig(self.pc.device_type,
+                              (self.pc.dims[0],) + (1,) * (len(self.pc.dims) - 1),
+                              self.pc.device_ids)
+
+    def flops_per_sample(self):
+        d = self.output.dims[-1]
+        per_tok = 2.0 * d * d * self.num_stages
+        if len(self.output.dims) == 3:
+            per_tok *= self.output.dims[1]
+        return per_tok

@@ -1,0 +1,116 @@
+"""GPipe-style pipeline parallelism over a mesh axis.
+
+The reference achieves pipeline-ish parallelism by pinning ops to specific
+GPUs and letting Legion overlap their execution (the NMT per-op GPU lists,
+nmt/nmt.cc:269-308; SURVEY.md §2.3 'Pipeline-ish / operator placement').
+The TPU-native equivalent is SPMD microbatch pipelining: each device along
+a ``pipe`` mesh axis holds ONE stage's weights; activations flow stage to
+stage via ``lax.ppermute`` while a ``lax.scan`` ticks through
+microbatches, filling and draining the bubble.  Backward follows from
+autodiff (the transpose of ppermute is the reverse permute; scan
+transposes to the reversed schedule).
+
+Constraint: every stage maps (mb, d) -> (mb, d) with the same activation
+shape (transformer-block style), so the ring buffer has one static shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+
+def sequential_stages(stage_fn: Callable, stage_params, x):
+    """Reference semantics: apply the P stacked stages in order (the
+    single-device fallback, and the per-device body when one device holds
+    several consecutive stages)."""
+    def body(h, p):
+        return stage_fn(p, h), None
+
+    h, _ = lax.scan(body, x, stage_params)
+    return h
+
+
+def gpipe_spmd(stage_fn: Callable, params_local, x_local, axis_name,
+               ring_size: int, num_microbatches: int):
+    """Run inside shard_map: one call per device along the pipe axis.
+
+    ``params_local``: this device's slice of the stacked stage weights
+    (leading dim = stages-per-device, consecutive stages).
+    ``x_local``: (B, d) microbatch source, identical on every stage.
+    Returns (B, d): the last stage's outputs, replicated to all stages.
+    """
+    P = ring_size
+    M = num_microbatches
+    B, *rest = x_local.shape
+    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    mb = B // M
+    mbs = x_local.reshape((M, mb) + tuple(rest))
+    s = lax.axis_index(axis_name)
+
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    T = M + P - 1
+    carry0 = jnp.zeros((mb,) + tuple(rest), x_local.dtype)
+    outbuf0 = jnp.zeros((M, mb) + tuple(rest), x_local.dtype)
+
+    def tick(state, t):
+        carry, outbuf = state
+        x_t = lax.dynamic_index_in_dim(mbs, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        inp = jnp.where(s == 0, x_t, carry)
+        y = sequential_stages(stage_fn, params_local, inp)
+        # last stage banks its result once the pipe is full
+        widx = jnp.clip(t - (P - 1), 0, M - 1)
+        prev = lax.dynamic_index_in_dim(outbuf, widx, 0, keepdims=False)
+        bank = jnp.where(jnp.logical_and(s == P - 1, t >= P - 1), y, prev)
+        outbuf = lax.dynamic_update_index_in_dim(outbuf, bank, widx, 0)
+        return (lax.ppermute(y, axis_name, perm), outbuf), None
+
+    (_, outbuf), _ = lax.scan(tick, (carry0, outbuf0), jnp.arange(T))
+    # replicate the last stage's outputs to every stage
+    mask = (s == P - 1).astype(jnp.float32)
+    out = lax.psum(outbuf.astype(jnp.float32) * mask, axis_name)
+    return out.astype(x_local.dtype).reshape((B,) + tuple(rest))
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
+                   pipe_axes: Union[str, Sequence[str]],
+                   num_microbatches: int,
+                   batch_axes: Optional[Union[str, Sequence[str]]] = None):
+    """Pipeline ``stage_fn`` over ``pipe_axes`` of ``mesh``.
+
+    ``stage_params``: pytree whose leaves have a leading stage dim P
+    (sharded over the pipe axes).  ``x``: (B, d) global activations
+    (optionally batch-sharded over ``batch_axes``).  Composes dp×pp: the
+    batch axes shard B while each pipe-axis slice runs its own pipeline.
+    """
+    pipe_axes = ((pipe_axes,) if isinstance(pipe_axes, str)
+                 else tuple(pipe_axes))
+    if batch_axes:
+        batch_axes = ((batch_axes,) if isinstance(batch_axes, str)
+                      else tuple(batch_axes))
+    axis_name = pipe_axes[0] if len(pipe_axes) == 1 else pipe_axes
+    ring = 1
+    for a in pipe_axes:
+        ring *= mesh.shape[a]
+    num_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    assert num_stages % ring == 0, \
+        f"{num_stages} stages not divisible over {ring} pipe devices"
+
+    bspec = batch_axes if batch_axes else None
+    x_spec = PartitionSpec(bspec, None)
+    p_spec = jax.tree.map(lambda _: PartitionSpec(pipe_axes), stage_params)
+
+    @partial(shard_map, mesh=mesh, in_specs=(p_spec, x_spec),
+             out_specs=x_spec, check_vma=False)
+    def run(pl, xl):
+        return gpipe_spmd(stage_fn, pl, xl, axis_name, ring,
+                          num_microbatches)
+
+    return run(stage_params, x)

@@ -47,6 +47,7 @@ _SPLITTABLE = {
     "ElementBinary": (0,),
     "LSTM": (0,),              # batch only: recurrence over T
     "MSELoss": (0,),
+    "PipelineMLP": (0, 1),     # dim 1 = pipeline (operator-dim) degree
 }
 
 
