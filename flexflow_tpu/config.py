@@ -139,6 +139,11 @@ class FFConfig:
     # ``compute_dtype`` (bfloat16 is the TPU-native default for benchmarks,
     # float32 for numerics tests).
     compute_dtype: str = "float32"
+    # Route optimizer updates through the fused Pallas kernels
+    # (kernels/fused_optimizer.py ≈ reference optimizer_kernel.cu).
+    # Only takes effect on single-device machines — Pallas calls are not
+    # GSPMD-partitionable, so sharded runs keep the jnp path.
+    fused_optimizer: bool = False
     # Per-op strategies, keyed by op name (the reference keys an equivalent
     # map by hash(op name) — include/config.h:102, strategy.cc:23-26; the
     # hash is an implementation detail of Legion mapper tags that the TPU
@@ -213,6 +218,8 @@ class FFConfig:
                 self.seed = int(take())
             elif a == "--bf16":
                 self.compute_dtype = "bfloat16"
+            elif a == "--fused-optimizer":
+                self.fused_optimizer = True
             else:
                 rest.append(a)
             i += 1

@@ -79,6 +79,8 @@ class FFModel:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._compiled = False
+        self._pipeline_req = None
+        self._pipeline_plan = None
 
     # ------------------------------------------------------------------
     # graph construction
@@ -233,6 +235,220 @@ class FFModel:
                  reduction: str = "average", name: Optional[str] = None) -> Tensor:
         return self._append(MSELoss(self, logits, labels, reduction, name))
 
+    # ------------------------------------------------------------------
+    # general pipeline parallelism (operator placement)
+    # ------------------------------------------------------------------
+    def set_pipeline(self, num_stages: Optional[int] = None,
+                     stages: Optional[Sequence[Sequence[str]]] = None,
+                     num_microbatches: int = 4,
+                     degree: Optional[int] = None,
+                     dp_degree: int = 1) -> None:
+        """Assign the op graph to pipeline stages (operator placement).
+
+        The reference pipelines heterogeneous graphs by pinning each op to
+        a GPU list (nmt/nmt.cc:269-308 pins encoder ops to one GPU set and
+        decoder ops to another; src/mapper/mapper.cc:33-146 places the
+        point tasks).  Here each stage is a contiguous run of ops executed
+        by one slice of the mesh's pipe axes, with activations crossing
+        stage boundaries over a ppermute ring under a GPipe microbatch
+        schedule (parallel/pipeline.py pipeline_graph_apply).
+
+        ``stages``: explicit op-name lists (contiguous partition of the
+        graph), or ``num_stages`` to auto-balance the chain by per-op
+        FLOPs.  ``degree``: ring size (defaults to num_stages; must divide
+        it).  ``dp_degree``: batch-parallel degree composed with the
+        pipeline (dp x pp).  Call before ``compile()``.
+        """
+        if stages is None:
+            assert num_stages is not None and num_stages >= 1
+            self._pipeline_req = {"num_stages": int(num_stages), "names": None}
+        else:
+            self._pipeline_req = {"num_stages": len(stages),
+                                  "names": [list(g) for g in stages]}
+        self._pipeline_req.update(num_microbatches=int(num_microbatches),
+                                  degree=degree, dp_degree=int(dp_degree))
+
+    def _plan_pipeline(self) -> None:
+        """Resolve ``set_pipeline`` into a validated stage plan.
+
+        The pipelined segment is the whole graph, minus a trailing Softmax
+        (kept outside so the loss can read the pre-softmax logits).  Each
+        stage must consume only tensors produced inside itself, the single
+        boundary tensor from the previous stage, or graph constants.
+        """
+        self._pipeline_plan = None
+        req = getattr(self, "_pipeline_req", None)
+        if req is None:
+            return
+        seg = list(self.ops)
+        tail: List[Op] = []
+        while seg and isinstance(seg[-1], Softmax):
+            tail.insert(0, seg.pop())
+        if not seg:
+            raise ValueError("pipeline: no ops to pipeline")
+        if req["names"] is not None:
+            by_name = {op.name: op for op in seg}
+            stages = []
+            for group in req["names"]:
+                stages.append([by_name[n] for n in group])
+            flat = [op for g in stages for op in g]
+            if flat != seg:
+                raise ValueError(
+                    "pipeline stages must be a contiguous in-order "
+                    "partition of the op graph (minus a trailing Softmax)")
+        else:
+            S = min(req["num_stages"], len(seg))
+            # Balance contiguous stages by cumulative per-op FLOPs (the
+            # reference balances by hand; nmt.cc splits encoder/decoder).
+            costs = [max(op.flops_per_sample(), 1.0) for op in seg]
+            total = sum(costs)
+            stages, acc, cur = [], 0.0, []
+            for idx, (op, c) in enumerate(zip(seg, costs)):
+                cur.append(op)
+                acc += c
+                ops_left = len(seg) - idx - 1
+                stages_left = S - len(stages) - 1
+                if len(stages) < S - 1 and (
+                        acc >= total * (len(stages) + 1) / S
+                        or ops_left <= stages_left):
+                    stages.append(cur)
+                    cur = []
+            if cur:
+                stages.append(cur)
+            stages = [g for g in stages if g]
+        S = len(stages)
+
+        # Validate dataflow FIRST (structural errors surface regardless of
+        # whether a ring is expressible): one boundary tensor between
+        # consecutive stages; nothing else crosses a stage or escapes.
+        const_guids = set(self._constants.keys())
+        stage_of: Dict[int, int] = {}
+        for si, g in enumerate(stages):
+            for op in g:
+                for t in op.outputs:
+                    stage_of[t.guid] = si
+        seg_in = stages[0][0].inputs[0]
+        boundaries: List[Tensor] = []
+        for si, g in enumerate(stages):
+            expected = seg_in if si == 0 else boundaries[si - 1]
+            for op in g:
+                for t in op.inputs:
+                    if t.guid in const_guids or t.guid == expected.guid:
+                        continue
+                    if stage_of.get(t.guid) == si:
+                        continue
+                    raise ValueError(
+                        f"pipeline: op {op.name} (stage {si}) consumes "
+                        f"tensor from stage {stage_of.get(t.guid)} that is "
+                        f"not the stage boundary; re-partition the stages")
+            out_t = g[-1].output
+            if si < S - 1:
+                boundaries.append(out_t)
+        final_out = stages[-1][-1].output
+        # nothing produced inside may be consumed after the segment except
+        # the final output
+        inner = set(stage_of.keys()) - {final_out.guid}
+        for op in tail:
+            for t in op.inputs:
+                if t.guid in inner:
+                    raise ValueError("pipeline: tensor escapes the segment")
+
+        import math
+        degree = req["degree"] if req["degree"] else S
+        degree = math.gcd(degree, S)
+        # Ring size must also be expressible over the mesh axes left after
+        # the dp group (e.g. degree 3 can't factor over a 2^k mesh).
+        while degree > 1:
+            try:
+                self.machine.axes_for_degrees([req["dp_degree"], degree])
+                break
+            except ValueError:
+                degree = max(d for d in range(1, degree)
+                             if S % d == 0 and degree % d == 0)
+        if degree <= 1 or self.machine.num_devices <= 1:
+            # No expressible ring: keep the ops' regular (data-parallel)
+            # configs rather than forcing no-split placeholders — a
+            # silently replicated segment would be a large perf
+            # regression versus not pipelining at all.
+            if self.machine.num_devices > 1:
+                print(f"flexflow_tpu: pipeline degree for {S} stages not "
+                      f"expressible over mesh "
+                      f"{dict(zip(self.machine.axis_names, self.machine.axis_sizes))}"
+                      f"; running without pipelining")
+            return
+        self._pipeline_plan = {
+            "stages": stages, "degree": int(degree),
+            "dp_degree": int(req["dp_degree"]),
+            "num_microbatches": int(req["num_microbatches"]),
+            "seg_in": seg_in, "seg_out": final_out,
+            "i0": self.ops.index(stages[0][0]),
+            "i1": self.ops.index(stages[-1][-1]) + 1,
+        }
+        # Pipelined ops execute inside the pipeline's shard_map: force
+        # their configs to no-split so op forwards take the plain jnp path
+        # (no nested shard_map) and their weights replicate over the mesh.
+        for g in stages:
+            for op in g:
+                if op.init_stats():
+                    raise ValueError(
+                        f"pipeline: op {op.name} carries running stats "
+                        f"(e.g. BatchNorm) — unsupported inside a pipeline")
+                op.pc = ParallelConfig(dims=(1,) * op.output.num_dims)
+
+    def _stage_fn(self, stage_ops: List[Op], in_guid: int):
+        const_items = list(self._constants.values())
+
+        def fn(params, h, ctx, micro_idx):
+            # Per-microbatch RNG stream: without the fold, every
+            # microbatch (and dp shard) would reuse one dropout mask.
+            rng = (jax.random.fold_in(ctx.rng, micro_idx)
+                   if ctx.rng is not None else None)
+            mctx = FwdCtx(training=ctx.training, rng=rng,
+                          stats_in=ctx.stats_in, stats_out=ctx.stats_out)
+            env = {in_guid: h}
+            for t, val in const_items:
+                fill_dtype = jnp.int32 if "int" in t.dtype else h.dtype
+                env[t.guid] = jnp.full(t.dims, val, fill_dtype)
+            for op in stage_ops:
+                xs = [env[t.guid] for t in op.inputs]
+                ys = op.forward(params.get(op.param_key, {}), xs, mctx)
+                for t, y in zip(op.outputs, ys):
+                    env[t.guid] = y
+            return env[stage_ops[-1].output.guid]
+
+        return fn
+
+    def _run_pipeline_segment(self, params, x, ctx):
+        from .parallel.pipeline import pipeline_graph_apply
+
+        plan = self._pipeline_plan
+        stages = plan["stages"]
+        fns = []
+        in_t = plan["seg_in"]
+        prev = in_t
+        in_shapes, out_shapes = [], []
+        for g in stages:
+            f = self._stage_fn(g, prev.guid)
+            fns.append(lambda p, h, mi, f=f: f(p, h, ctx, mi))
+            in_shapes.append(tuple(prev.dims[1:]))
+            out_shapes.append(tuple(g[-1].output.dims[1:]))
+            prev = g[-1].output
+        groups = self.machine.axes_for_degrees(
+            [plan["dp_degree"], plan["degree"]])
+        batch_axes = groups[0] if groups[0] else None
+        pipe_axes = groups[1]
+        # Per-shard microbatch count (the shard_map body sees the batch
+        # after dp sharding).
+        local_b = x.shape[0] // max(1, plan["dp_degree"])
+        mb = min(plan["num_microbatches"], local_b)
+        while local_b % mb != 0:
+            mb -= 1
+        seg_params = {op.param_key: params[op.param_key]
+                      for g in stages for op in g if op.param_key in params}
+        return pipeline_graph_apply(fns, seg_params, x, self.machine.mesh,
+                                    pipe_axes, mb, in_shapes, out_shapes,
+                                    batch_axes=batch_axes)
+
     def _unary(self, op_name, x, name=None):
         return self._append(ElementUnary(self, x, op_name, name))
 
@@ -331,6 +547,18 @@ class FFModel:
                 pc = ParallelConfig.data_parallel(op.output.num_dims, nd)
             op.pc = self._legalize_pc(op, pc)
 
+        # Resolve operator placement (general pipeline parallelism) —
+        # overrides the pipelined ops' configs with no-split placeholders.
+        self._plan_pipeline()
+
+        # Fused Pallas optimizer kernels: single-device only (the Pallas
+        # custom call is not GSPMD-partitionable across a mesh).
+        # Unconditional assignment so an optimizer reused across
+        # compiles never carries a stale True onto a sharded machine.
+        if optimizer is not None:
+            optimizer.fused = bool(cfg.fused_optimizer
+                                   and self.machine.num_devices == 1)
+
         # Export AFTER resolution so imported/searched configs are what get
         # written (reference exports from FFConfig::strategies the same way).
         if cfg.export_strategy_file:
@@ -351,22 +579,9 @@ class FFModel:
         self._eval_step_fn = None
 
     def _legalize_pc(self, op: Op, pc: ParallelConfig) -> ParallelConfig:
-        """Clamp each dim's partition degree to a divisor of the dim size
-        (a tiny batch can't split over the whole mesh; the reference simply
-        asserts — we degrade to the largest legal degree)."""
-        import math
-
-        dims = list(pc.dims)
-        changed = False
-        for i, d in enumerate(dims):
-            if i < op.output.num_dims and op.output.dims[i] % d != 0:
-                dims[i] = math.gcd(d, op.output.dims[i])
-                changed = True
-        if not changed:
-            return pc
-        npc = ParallelConfig(pc.device_type, tuple(dims),
-                             memory_types=pc.memory_types)
-        return npc.with_device_ids(tuple(range(npc.num_parts())))
+        """Clamp a config to one the op can execute (op-specific hook:
+        ops/base.py Op.legalize_pc)."""
+        return op.legalize_pc(pc)
 
     def _all_strategies(self) -> Dict[str, ParallelConfig]:
         return {op.name: getattr(op, "pc", ParallelConfig.data_parallel(
@@ -534,7 +749,20 @@ class FFModel:
             env[t.guid] = jnp.full(t.dims, val, fill_dtype)
         ctx = FwdCtx(training=training, rng=rng, stats_in=stats,
                      stats_out={} if training else None)
-        for op in self.ops:
+        plan = getattr(self, "_pipeline_plan", None)
+        use_pipe = (plan is not None and multi and plan["degree"] > 1)
+        i = 0
+        while i < len(self.ops):
+            if use_pipe and i == plan["i0"]:
+                # Pipelined segment: GPipe microbatch schedule over the
+                # pipe mesh axes (parallel/pipeline.py), replacing the
+                # sequential op walk for ops[i0:i1].
+                y = self._run_pipeline_segment(
+                    params, env[plan["seg_in"].guid], ctx)
+                env[plan["seg_out"].guid] = y
+                i = plan["i1"]
+                continue
+            op = self.ops[i]
             xs = [env[t.guid] for t in op.inputs]
             pvals = params.get(op.param_key, {})
             ys = op.forward(pvals, xs, ctx)
@@ -543,12 +771,16 @@ class FFModel:
                 ys = [self.machine.constraint(y, cpc) for y in ys]
             for t, y in zip(op.outputs, ys):
                 env[t.guid] = y
+            i += 1
         new_stats = dict(stats)
         if training and ctx.stats_out:
             new_stats.update(ctx.stats_out)
         return env, new_stats
 
     def _input_batch_degree(self, t: Tensor) -> int:
+        plan = getattr(self, "_pipeline_plan", None)
+        if plan is not None and t.guid == plan["seg_in"].guid:
+            return plan["dp_degree"]
         for op in self.ops:
             if t in op.inputs:
                 return op.pc.dims[0]

@@ -93,6 +93,36 @@ class Op:
         non-layout meaning (e.g. the pipeline degree) override this."""
         return self.pc
 
+    def _config_dim_bound(self, i: int) -> Optional[int]:
+        """The size config dim ``i``'s degree must divide (None: no
+        bound).  Ops whose config dims carry non-size meaning override
+        this (PipelineMLP's dim 1 is the pipe degree, bounded by
+        num_stages rather than the feature width)."""
+        return self.output.dims[i] if i < self.output.num_dims else None
+
+    def legalize_pc(self, pc):
+        """Clamp a proposed config to one this op can execute — used by
+        compile() and by BOTH search paths before costing a candidate.
+        Each dim's degree must divide the op's bound for that dim (the
+        reference simply asserts; we degrade to the largest legal
+        degree)."""
+        import math
+
+        from ..config import ParallelConfig
+
+        dims = list(pc.dims)
+        changed = False
+        for i, d in enumerate(dims):
+            bound = self._config_dim_bound(i)
+            if bound is not None and bound % d != 0:
+                dims[i] = math.gcd(d, bound)
+                changed = True
+        if not changed:
+            return pc
+        npc = ParallelConfig(pc.device_type, tuple(dims),
+                             memory_types=pc.memory_types)
+        return npc.with_device_ids(tuple(range(npc.num_parts())))
+
     # -- stats (non-trainable state, e.g. batchnorm running moments) -------
     def init_stats(self) -> Dict[str, jax.Array]:
         return {}

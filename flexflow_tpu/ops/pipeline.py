@@ -57,6 +57,15 @@ class PipelineMLP(Op):
             return 1
         return pc.dims[1]
 
+    def _config_dim_bound(self, i: int):
+        """Config dim 1 is the PIPELINE degree: legal iff it divides
+        ``num_stages`` (the stage-dim weight sharding and the ppermute
+        ring both require it) — NOT the feature width that the base
+        size check would compare against."""
+        if i == 1:
+            return self.num_stages
+        return super()._config_dim_bound(i)
+
     def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
         x = xs[0]
         tree = {"kernel": params["kernel"], "bias": params["bias"]}
@@ -69,8 +78,12 @@ class PipelineMLP(Op):
             groups = machine.axes_for_degrees(degrees[:2])
             batch_axes = groups[0] if groups[0] else None
             pipe_axes = groups[1]
-            mb = min(self.num_microbatches, x.shape[0])
-            while x.shape[0] % mb != 0:
+            # gpipe_spmd sees the PER-SHARD batch (after dp sharding over
+            # config dim 0), so microbatch divisibility is checked against
+            # the local batch, not the global one.
+            local_b = x.shape[0] // max(1, degrees[0])
+            mb = min(self.num_microbatches, local_b)
+            while local_b % mb != 0:
                 mb -= 1
             return [pipeline_apply(self._stage, tree, x, machine.mesh,
                                    pipe_axes, mb, batch_axes=batch_axes)]

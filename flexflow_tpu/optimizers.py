@@ -69,6 +69,12 @@ class SGDOptimizer(Optimizer):
         self.momentum = float(momentum)
         self.nesterov = bool(nesterov)
         self.weight_decay = float(weight_decay)
+        # Set by FFModel.compile from FFConfig.fused_optimizer: route the
+        # update through the Pallas kernels (kernels/fused_optimizer.py,
+        # the analogue of the reference's optimizer_kernel.cu).  Pallas
+        # calls are not GSPMD-partitionable, so compile only enables this
+        # on single-device machines.
+        self.fused = False
 
     def init_state(self, params):
         if self.momentum > 0.0:
@@ -81,6 +87,24 @@ class SGDOptimizer(Optimizer):
     def apply(self, params, grads, state, hparams):
         lr = hparams["lr"]
         wd, mom = self.weight_decay, self.momentum
+
+        if self.fused:
+            from .kernels.fused_optimizer import fused_sgd_update
+
+            if mom > 0.0:
+                def fupd(w, g, v):
+                    return fused_sgd_update(w, g, v, lr, wd, mom,
+                                            self.nesterov)
+
+                out = jax.tree.map(fupd, params, grads, state["v"])
+                new_params, new_v = _unzip(out, 2)
+                return new_params, {"v": new_v}
+
+            def fupd_plain(w, g):
+                # momentum buffer unused: the kernel passes it through
+                return fused_sgd_update(w, g, g, lr, wd, 0.0, False)[0]
+
+            return jax.tree.map(fupd_plain, params, grads), {}
 
         if mom > 0.0:
             def upd(w, g, v):
@@ -112,6 +136,7 @@ class AdamOptimizer(Optimizer):
         self.beta1_t = 1.0
         self.beta2_t = 1.0
         self.alpha_t = self.alpha
+        self.fused = False  # see SGDOptimizer.fused
 
     def next_epoch(self):
         self.beta1_t *= self.beta1
@@ -130,6 +155,16 @@ class AdamOptimizer(Optimizer):
     def apply(self, params, grads, state, hparams):
         alpha_t = hparams["alpha_t"]
         wd, b1, b2, eps = self.weight_decay, self.beta1, self.beta2, self.epsilon
+
+        if self.fused:
+            from .kernels.fused_optimizer import fused_adam_update
+
+            def fupd(w, g, m, v):
+                return fused_adam_update(w, g, m, v, alpha_t, wd, b1, b2, eps)
+
+            out = jax.tree.map(fupd, params, grads, state["m"], state["v"])
+            new_params, new_m, new_v = _unzip(out, 3)
+            return new_params, {"m": new_m, "v": new_v}
 
         def upd(w, g, m, v):
             gt = (g + wd * w).astype(jnp.float32)

@@ -17,13 +17,14 @@ shape (transformer-block style), so the ring buffer has one static shape.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional, Sequence, Tuple, Union
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def sequential_stages(stage_fn: Callable, stage_params, x):
@@ -106,11 +107,191 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
     bspec = batch_axes if batch_axes else None
     x_spec = PartitionSpec(bspec, None)
     p_spec = jax.tree.map(lambda _: PartitionSpec(pipe_axes), stage_params)
+    extra = _unused_axes(mesh, set(pipe_axes) | set(batch_axes or ()))
 
     @partial(shard_map, mesh=mesh, in_specs=(p_spec, x_spec),
              out_specs=x_spec, check_vma=False)
     def run(pl, xl):
-        return gpipe_spmd(stage_fn, pl, xl, axis_name, ring,
-                          num_microbatches)
+        y = gpipe_spmd(stage_fn, pl, xl, axis_name, ring,
+                       num_microbatches)
+        return _replica_correct(y, mesh, extra)
 
     return run(stage_params, x)
+
+
+def _unused_axes(mesh: Mesh, used) -> Tuple[str, ...]:
+    return tuple(a for a in mesh.axis_names if a not in used)
+
+
+def _replica_correct(y, mesh: Mesh, extra: Tuple[str, ...]):
+    """Identity on the forward value, gradient-correct on the backward.
+
+    When the pipeline occupies only a subset of the mesh axes, the
+    computation is replicated over the unused axes; shard_map's transpose
+    then psums replicated-input cotangents over ALL mesh axes, counting
+    each replica's (identical, full) contribution once per replica.
+    Emitting ``psum(y / R)`` over the unused axes leaves the forward value
+    unchanged (R identical copies of y/R) while scaling each replica's
+    cotangent to dout/R, so the transpose's psum reconstructs the true
+    gradient exactly once.
+    """
+    if not extra:
+        return y
+    r = 1
+    for a in extra:
+        r *= mesh.shape[a]
+    ax = extra if len(extra) > 1 else extra[0]
+    return lax.psum(y / r, ax)
+
+
+# ----------------------------------------------------------------------
+# Heterogeneous pipelines: arbitrary per-stage subgraphs
+# ----------------------------------------------------------------------
+#
+# The reference pipelines HETEROGENEOUS ops by pinning each op to a GPU
+# list (nmt/nmt.cc:269-308 assigns encoder ops to one set of GPUs and
+# decoder ops to another; the mapper places every point task accordingly,
+# src/mapper/mapper.cc:33-146).  The TPU-native equivalent below keeps
+# the SPMD single-program constraint: inside a shard_map over the pipe
+# axis every device runs ``lax.switch`` on its own stage index, so device
+# group s executes ONLY stage s's subgraph — placement by branch, the
+# moral twin of the reference's placement by mapper.  Activations cross
+# stage boundaries as flattened buffers padded to the largest boundary
+# size so the ppermute ring keeps one static shape.
+
+
+def _flat_pad(y: jax.Array, pad: int, dtype) -> jax.Array:
+    flat = y.reshape(y.shape[0], -1).astype(dtype)
+    if flat.shape[1] < pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad - flat.shape[1])))
+    return flat
+
+
+def _unflat(h: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Array:
+    n = int(np.prod(shape)) if shape else 1
+    return h[:, :n].reshape((h.shape[0],) + tuple(shape)).astype(dtype)
+
+
+def gpipe_hetero_spmd(stage_fns: Sequence[Callable], params, x_local,
+                      axis_name, ring_size: int, num_microbatches: int,
+                      in_shapes: Sequence[Tuple[int, ...]],
+                      out_shapes: Sequence[Tuple[int, ...]],
+                      dtype) -> jax.Array:
+    """GPipe schedule for per-stage heterogeneous functions.
+
+    Runs inside shard_map over the pipe axis.  ``stage_fns[s]`` maps a
+    (mb,)+in_shapes[s] microbatch to (mb,)+out_shapes[s]; every function
+    receives the full ``params`` tree and closes over only what it needs
+    (autodiff flows through the switch branches).  ``x_local``: this
+    device's (B, flat) batch of flattened stage-0 inputs.
+    """
+    P = ring_size
+    M = num_microbatches
+    B = x_local.shape[0]
+    assert B % M == 0, f"local batch {B} not divisible by {M} microbatches"
+    mb = B // M
+    pad = x_local.shape[1]
+    mbs = x_local.reshape(M, mb, pad)
+    s = lax.axis_index(axis_name)
+
+    def make_branch(i):
+        def branch(h, micro_idx):
+            y = stage_fns[i](params, _unflat(h, in_shapes[i], dtype),
+                             micro_idx)
+            return _flat_pad(y, pad, dtype)
+        return branch
+
+    branches = [make_branch(i) for i in range(P)]
+
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    T = M + P - 1
+    carry0 = jnp.zeros((mb, pad), dtype)
+    outbuf0 = jnp.zeros((M, mb, pad), dtype)
+
+    def tick(state, t):
+        carry, outbuf = state
+        x_t = lax.dynamic_index_in_dim(mbs, jnp.clip(t, 0, M - 1), 0,
+                                       keepdims=False)
+        inp = jnp.where(s == 0, x_t, carry)
+        # this device's current microbatch index (stage s sees mb t-s);
+        # stochastic ops fold it into their RNG for per-microbatch draws
+        micro_idx = jnp.clip(t - s, 0, M - 1)
+        y = lax.switch(s, branches, inp, micro_idx)
+        widx = jnp.clip(t - (P - 1), 0, M - 1)
+        prev = lax.dynamic_index_in_dim(outbuf, widx, 0, keepdims=False)
+        bank = jnp.where(jnp.logical_and(s == P - 1, t >= P - 1), y, prev)
+        outbuf = lax.dynamic_update_index_in_dim(outbuf, bank, widx, 0)
+        return (lax.ppermute(y, axis_name, perm), outbuf), None
+
+    (_, outbuf), _ = lax.scan(tick, (carry0, outbuf0), jnp.arange(T))
+    mask = (s == P - 1).astype(jnp.float32)
+    out = lax.psum(outbuf.astype(jnp.float32) * mask, axis_name)
+    n_out = int(np.prod(out_shapes[P - 1]))
+    return out.astype(dtype).reshape(B, pad)[:, :n_out]
+
+
+def pipeline_graph_apply(stage_fns: Sequence[Callable], params, x,
+                         mesh: Mesh,
+                         pipe_axes: Union[str, Sequence[str]],
+                         num_microbatches: int,
+                         in_shapes: Sequence[Tuple[int, ...]],
+                         out_shapes: Sequence[Tuple[int, ...]],
+                         batch_axes: Optional[Union[str, Sequence[str]]] = None):
+    """Pipeline a chain of heterogeneous stage functions over ``pipe_axes``.
+
+    ``stage_fns[s](params, h, micro_idx)`` consumes/produces per-sample
+    shapes ``in_shapes[s]`` / ``out_shapes[s]`` (out_shapes[s] ==
+    in_shapes[s+1]); ``micro_idx`` is the microbatch index for stochastic
+    ops' RNG streams.  When the ring is smaller than ``len(stage_fns)``,
+    consecutive stages are composed onto one device.  ``x``:
+    (B,)+in_shapes[0] global input, optionally batch-sharded over
+    ``batch_axes`` (dp×pp composition).  Returns (B,)+out_shapes[-1].
+    """
+    pipe_axes = ((pipe_axes,) if isinstance(pipe_axes, str)
+                 else tuple(pipe_axes))
+    if batch_axes:
+        batch_axes = ((batch_axes,) if isinstance(batch_axes, str)
+                      else tuple(batch_axes))
+    axis_name = pipe_axes[0] if len(pipe_axes) == 1 else pipe_axes
+    ring = 1
+    for a in pipe_axes:
+        ring *= mesh.shape[a]
+    S = len(stage_fns)
+    assert S % ring == 0, f"{S} stages not divisible over {ring} pipe devices"
+    k = S // ring
+
+    # Group consecutive stages onto each ring slot.
+    def compose(lo, hi):
+        def fn(p, h, micro_idx):
+            for i in range(lo, hi):
+                h = stage_fns[i](p, h, micro_idx)
+            return h
+        return fn
+
+    ring_fns = [compose(r * k, (r + 1) * k) for r in range(ring)]
+    ring_in = [tuple(in_shapes[r * k]) for r in range(ring)]
+    ring_out = [tuple(out_shapes[(r + 1) * k - 1]) for r in range(ring)]
+
+    dtype = x.dtype
+    boundary = ring_in + [ring_out[-1]]
+    pad = max(int(np.prod(sh)) if sh else 1 for sh in boundary)
+    xf = _flat_pad(x, pad, dtype)
+
+    bspec = (batch_axes[0] if len(batch_axes) == 1 else batch_axes) \
+        if batch_axes else None
+    x_spec = PartitionSpec(bspec, None)
+    p_spec = jax.tree.map(lambda _: PartitionSpec(), params)
+    extra = _unused_axes(mesh, set(pipe_axes) | set(batch_axes or ()))
+
+    @partial(shard_map, mesh=mesh, in_specs=(p_spec, x_spec),
+             out_specs=x_spec, check_vma=False)
+    def run(pl, xl):
+        y = gpipe_hetero_spmd(ring_fns, pl, xl, axis_name, ring,
+                              num_microbatches, ring_in, ring_out, dtype)
+        return _replica_correct(y, mesh, extra)
+
+    out_flat = run(params, xf)
+    B = x.shape[0]
+    return out_flat.reshape((B,) + tuple(out_shapes[-1]))
+
+

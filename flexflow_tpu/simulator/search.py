@@ -103,7 +103,10 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
     for it in range(budget):
         op = rng.choice(model.ops)
         nxt = dict(current)
-        nxt[op.name] = random_parallel_config(op, nd, rng)
+        # Legalize through the op hook so configs whose dims carry
+        # non-size meaning (PipelineMLP pipe degree) are clamped against
+        # the real bound before costing (same as the native engine path).
+        nxt[op.name] = op.legalize_pc(random_parallel_config(op, nd, rng))
         nxt_rt = sim.simulate_runtime(model, nxt)
         if verbose and it % 100 == 0:
             print(f"iter({it}) cur({current_rt * 1e3:.3f}ms) "
