@@ -384,6 +384,8 @@ class FFModel:
             "i0": self.ops.index(stages[0][0]),
             "i1": self.ops.index(stages[-1][-1]) + 1,
         }
+        self._pipeline_plan["pack"] = self._plan_pipeline_pack(
+            stages, int(degree))
         # Pipelined ops execute inside the pipeline's shard_map: force
         # their configs to no-split so op forwards take the plain jnp path
         # (no nested shard_map) and their weights replicate over the mesh.
@@ -395,8 +397,97 @@ class FFModel:
                         f"(e.g. BatchNorm) — unsupported inside a pipeline")
                 op.pc = ParallelConfig(dims=(1,) * op.output.num_dims)
 
+    def _plan_pipeline_pack(self, stages, ring: int):
+        """Stage-weight placement layout: pack each ring slot's weights
+        into one row of a (ring, width) float32 buffer sharded over the
+        pipe axes, so an S-slot pipeline stores ~1/S of the segment's
+        weights per device — the analogue of the reference mapper placing
+        each op's weights only on its assigned GPUs
+        (src/mapper/mapper.cc:33-146).  Weights shared across slots or
+        with ops outside the segment stay replicated (excluded).
+
+        Returns {"entries": {param_key: {wname: (slot, off, shape, n)}},
+        "ring": ring, "width": W} or None when nothing is packable.
+        """
+        S = len(stages)
+        k = S // ring
+        seg_ops = [op for g in stages for op in g]
+        key_slot: Dict[str, int] = {}
+        conflict = set()
+        for si, g in enumerate(stages):
+            r = si // k
+            for op in g:
+                owner = op.share_from if op.share_from is not None else op
+                if not owner.weights:
+                    continue
+                pk = op.param_key
+                if pk in key_slot and key_slot[pk] != r:
+                    conflict.add(pk)
+                key_slot.setdefault(pk, r)
+        seg_ids = {id(op) for op in seg_ops}
+        for op in self.ops:
+            if id(op) not in seg_ids and op.param_key in key_slot:
+                conflict.add(op.param_key)
+        slot_off = [0] * ring
+        entries: Dict[str, Dict[str, tuple]] = {}
+        for op in seg_ops:  # graph order: deterministic offsets
+            owner = op.share_from if op.share_from is not None else op
+            pk = op.param_key
+            if (not owner.weights or pk in conflict or pk in entries
+                    or pk not in key_slot):
+                continue
+            if any(w.dtype != "float32" for w in owner.weights):
+                continue  # packing assumes one buffer dtype
+            r = key_slot[pk]
+            emap = {}
+            for w in owner.weights:
+                n = int(np.prod(w.dims))
+                emap[w.name] = (r, slot_off[r], tuple(w.dims), n)
+                slot_off[r] += n
+            entries[pk] = emap
+        width = max(slot_off) if entries else 0
+        if width == 0:
+            return None
+        return {"entries": entries, "ring": ring, "width": width}
+
+    def _pipe_pack(self):
+        plan = getattr(self, "_pipeline_plan", None)
+        return plan.get("pack") if plan else None
+
+    # Pack-entry layout (slot, off, shape, n) read/write in one place.
+    @staticmethod
+    def _pack_read(buf_row, entry):
+        _, off, shape, n = entry
+        return buf_row[off:off + n].reshape(shape)
+
+    @staticmethod
+    def _pack_write(buf, entry, value):
+        r, off, _, n = entry
+        return buf.at[r, off:off + n].set(value.reshape(-1))
+
+    def _pipe_buffer_sharding(self) -> NamedSharding:
+        plan = self._pipeline_plan
+        groups = self.machine.axes_for_degrees(
+            [plan["dp_degree"], plan["degree"]])
+        paxes = groups[1]
+        return NamedSharding(
+            self.machine.mesh,
+            PartitionSpec(paxes if len(paxes) > 1 else paxes[0]))
+
     def _stage_fn(self, stage_ops: List[Op], in_guid: int):
         const_items = list(self._constants.values())
+        pack = self._pipe_pack()
+
+        def resolve(params, op):
+            """Op weights: packed stage-local slice of the pipe buffer
+            (this device's row of the (ring, W) buffer — inside the
+            shard_map the local view is (1, W)), else the plain tree."""
+            pk = op.param_key
+            if pack and pk in pack["entries"]:
+                local = params["_pipe"]["buffer"].reshape(-1)
+                return {wn: FFModel._pack_read(local, e)
+                        for wn, e in pack["entries"][pk].items()}
+            return params.get(pk, {})
 
         def fn(params, h, ctx, micro_idx):
             # Per-microbatch RNG stream: without the fold, every
@@ -411,7 +502,7 @@ class FFModel:
                 env[t.guid] = jnp.full(t.dims, val, fill_dtype)
             for op in stage_ops:
                 xs = [env[t.guid] for t in op.inputs]
-                ys = op.forward(params.get(op.param_key, {}), xs, mctx)
+                ys = op.forward(resolve(params, op), xs, mctx)
                 for t, y in zip(op.outputs, ys):
                     env[t.guid] = y
             return env[stage_ops[-1].output.guid]
@@ -445,9 +536,18 @@ class FFModel:
             mb -= 1
         seg_params = {op.param_key: params[op.param_key]
                       for g in stages for op in g if op.param_key in params}
+        param_specs = None
+        pack = self._pipe_pack()
+        if pack:
+            seg_params["_pipe"] = params["_pipe"]
+            param_specs = {k: jax.tree.map(lambda _: PartitionSpec(), v)
+                           for k, v in seg_params.items()}
+            param_specs["_pipe"] = {
+                "buffer": self._pipe_buffer_sharding().spec}
         return pipeline_graph_apply(fns, seg_params, x, self.machine.mesh,
                                     pipe_axes, mb, in_shapes, out_shapes,
-                                    batch_axes=batch_axes)
+                                    batch_axes=batch_axes,
+                                    param_specs=param_specs)
 
     def _unary(self, op_name, x, name=None):
         return self._append(ElementUnary(self, x, op_name, name))
@@ -523,7 +623,7 @@ class FFModel:
             from .simulator.machine import TPUMachineModel
             from .simulator.native_search import native_mcmc_search
 
-            mm = TPUMachineModel(num_devices=self.machine.num_devices)
+            mm = TPUMachineModel.calibrated(num_devices=self.machine.num_devices)
             best = None
             r = native_mcmc_search(self, budget=cfg.search_budget,
                                    alpha=cfg.search_alpha, machine_model=mm,
@@ -605,8 +705,14 @@ class FFModel:
     def _param_spec_tree(self) -> Dict[str, Dict[str, NamedSharding]]:
         out: Dict[str, Dict[str, NamedSharding]] = {}
         self._offload: Dict[Tuple[str, str], Tuple[NamedSharding, NamedSharding]] = {}
+        pack = self._pipe_pack()
+        packed_keys = set(pack["entries"]) if pack else set()
+        if pack:
+            # Stage weights live in the pipe buffer: one row per ring
+            # slot, sharded over the pipe axes (1/ring per device).
+            out["_pipe"] = {"buffer": self._pipe_buffer_sharding()}
         for op in self.ops:
-            if not op.weights:
+            if not op.weights or op.name in packed_keys:
                 continue
             degrees = list(op.pc.dims)
             rank = op.output.num_dims
@@ -682,20 +788,31 @@ class FFModel:
         shardings = self._param_spec_tree()
 
         ops_with_weights = [op for op in self.ops if op.weights]
+        pack = self._pipe_pack()
 
         import zlib
 
         def init_fn(key):
             params = {}
+            buf = (jnp.zeros((pack["ring"], pack["width"]), jnp.float32)
+                   if pack else None)
             for op in ops_with_weights:
                 p = {}
                 for w in op.weights:
                     # Deterministic per-(op, weight) stream: same graph →
                     # same init regardless of strategy or process history.
                     salt = zlib.crc32(f"{op.name}/{w.name}".encode())
-                    p[w.name] = w.initializer(jax.random.fold_in(key, salt),
-                                              w.dims, jnp.float32)
-                params[op.name] = p
+                    v = w.initializer(jax.random.fold_in(key, salt),
+                                      w.dims, jnp.float32)
+                    if pack and op.name in pack["entries"]:
+                        buf = self._pack_write(
+                            buf, pack["entries"][op.name][w.name], v)
+                    else:
+                        p[w.name] = v
+                if p:
+                    params[op.name] = p
+            if pack:
+                params["_pipe"] = {"buffer": buf}
             return params
 
         # Offloaded weights are initialized on device (the SPMD partitioner
@@ -976,10 +1093,27 @@ class FFModel:
         from .runtime.profiling import print_op_profile
         print_op_profile(self)
 
+    def _pack_entry(self, op_name: str, weight_name: str):
+        pack = self._pipe_pack()
+        if pack and op_name in pack["entries"]:
+            return pack["entries"][op_name].get(weight_name)
+        return None
+
     def get_parameter(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        e = self._pack_entry(op_name, weight_name)
+        if e is not None:
+            buf = np.asarray(self._params["_pipe"]["buffer"])
+            return np.asarray(self._pack_read(buf[e[0]], e))
         return np.asarray(self._params[op_name][weight_name])
 
     def set_parameter(self, op_name: str, weight_name: str, value: np.ndarray) -> None:
+        e = self._pack_entry(op_name, weight_name)
+        if e is not None:
+            cur = self._params["_pipe"]["buffer"]
+            new = self._pack_write(jnp.asarray(cur), e,
+                                   jnp.asarray(value, jnp.float32))
+            self._params["_pipe"]["buffer"] = jax.device_put(new, cur.sharding)
+            return
         cur = self._params[op_name][weight_name]
         self._params[op_name][weight_name] = jax.device_put(
             jnp.asarray(value, dtype=cur.dtype), cur.sharding)

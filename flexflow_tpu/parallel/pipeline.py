@@ -236,7 +236,8 @@ def pipeline_graph_apply(stage_fns: Sequence[Callable], params, x,
                          num_microbatches: int,
                          in_shapes: Sequence[Tuple[int, ...]],
                          out_shapes: Sequence[Tuple[int, ...]],
-                         batch_axes: Optional[Union[str, Sequence[str]]] = None):
+                         batch_axes: Optional[Union[str, Sequence[str]]] = None,
+                         param_specs=None):
     """Pipeline a chain of heterogeneous stage functions over ``pipe_axes``.
 
     ``stage_fns[s](params, h, micro_idx)`` consumes/produces per-sample
@@ -246,6 +247,16 @@ def pipeline_graph_apply(stage_fns: Sequence[Callable], params, x,
     consecutive stages are composed onto one device.  ``x``:
     (B,)+in_shapes[0] global input, optionally batch-sharded over
     ``batch_axes`` (dp×pp composition).  Returns (B,)+out_shapes[-1].
+
+    ``param_specs``: optional PartitionSpec tree matching ``params``.
+    Default replicates every leaf; the caller passes pipe-axis-sharded
+    specs for stage-local weights (FFModel packs each ring slot's stage
+    weights into a (ring, W) buffer sharded here, so an S-slot pipeline
+    stores ~1/S of the model per device — the analogue of the reference
+    mapper placing each op's weights only on its assigned GPUs,
+    src/mapper/mapper.cc:33-146).  Stage fns read their slot's slice of
+    the local view; shard_map's transpose keeps sharded-leaf cotangents
+    local, so each device only ever materializes its own slot's grads.
     """
     pipe_axes = ((pipe_axes,) if isinstance(pipe_axes, str)
                  else tuple(pipe_axes))
@@ -280,7 +291,8 @@ def pipeline_graph_apply(stage_fns: Sequence[Callable], params, x,
     bspec = (batch_axes[0] if len(batch_axes) == 1 else batch_axes) \
         if batch_axes else None
     x_spec = PartitionSpec(bspec, None)
-    p_spec = jax.tree.map(lambda _: PartitionSpec(), params)
+    p_spec = (param_specs if param_specs is not None
+              else jax.tree.map(lambda _: PartitionSpec(), params))
     extra = _unused_axes(mesh, set(pipe_axes) | set(batch_axes or ()))
 
     @partial(shard_map, mesh=mesh, in_specs=(p_spec, x_spec),

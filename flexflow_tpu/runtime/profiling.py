@@ -47,8 +47,9 @@ def op_profile(model, which: str = "both") -> Dict[str, Dict[str, float]]:
     from ..simulator.cost_model import CostModel
     from ..simulator.machine import TPUMachineModel
 
-    cm = CostModel(TPUMachineModel(num_devices=model.machine.num_devices),
-                   measure=True)
+    cm = CostModel(TPUMachineModel.calibrated(num_devices=model.machine.num_devices),
+                   measure=True, compute_dtype=model.config.compute_dtype,
+                   target_platform=jax.default_backend())
     out: Dict[str, Dict[str, float]] = {}
     for op in model.ops:
         pc = getattr(op, "pc", None)

@@ -1,50 +1,93 @@
 """Per-op compute-cost model: measured on the real chip, cached, with a
-roofline fallback.
+calibrated roofline fallback.
 
 TPU analogue of the reference's ``measure_compute_time`` machinery
 (reference: Op::measure_compute_time per op, e.g. conv_2d.cu:937-1039,
 cached by (op, config) hash in simulator.cc:235-273).  On TPU a compile
-costs seconds, not microseconds, so caching is mandatory: measurements key
-on (op type, sub-tensor shape signature) and persist to disk
-(.simcache.json) across processes — the analogue of the reference's
-in-memory hash_to_op_{forward,backward}_time maps, made durable.
+costs seconds, not microseconds, so caching is mandatory and durable:
 
-When no accelerator is available (or measure=False) the cost comes from a
-roofline: time = max(flops / (peak·eff), bytes / hbm_bw) + launch overhead.
+  * measurements key on (op type, per-part sub-shape, dtype, direction)
+    and persist to disk — the analogue of the reference's in-memory
+    ``hash_to_op_{forward,backward}_time`` maps, made durable;
+  * only REAL measurements are persisted, tagged with the platform they
+    were taken on (``{"t": sec, "measured": true, "platform": "tpu"}``)
+    so CPU-measured values can never masquerade as chip timings;
+  * a committed cache (``measured_v5e.json``, produced by
+    ``tools/calibrate.py`` on the real v5e) ships with the package, so
+    every search — including offline search on a CPU-only host — costs
+    candidates with real chip timings where available;
+  * anything uncached falls back to a roofline
+    ``max(flops / (peak·eff), bytes / hbm_bw) + overhead`` whose
+    ``mxu_efficiency`` / overhead / backward-multiplier constants are
+    themselves fitted to the measurements (machine_v5e.json).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from .machine import TPUMachineModel
 
+# Committed on-chip measurement cache, produced by tools/calibrate.py.
+MEASURED_CACHE = os.path.join(os.path.dirname(__file__), "measured_v5e.json")
+
 
 class CostModel:
     def __init__(self, machine: TPUMachineModel, measure: bool = False,
-                 cache_path: str = ".simcache.json"):
+                 cache_path: str = ".simcache.json",
+                 compute_dtype: str = "float32",
+                 measured_cache_path: Optional[str] = None,
+                 target_platform: str = "tpu"):
         self.machine = machine
         self.measure = measure
         self.cache_path = cache_path
-        self._cache: Dict[str, float] = {}
-        if cache_path and os.path.exists(cache_path):
+        self.compute_dtype = compute_dtype
+        self.target_platform = target_platform
+        self._measured: Dict[str, float] = {}
+        self._analytic_memo: Dict[str, float] = {}
+        self._measure_failed: set = set()  # don't re-compile known failures
+        self.stats = {"measured_hits": 0, "measured_runs": 0, "analytic": 0}
+        # Packaged calibrated cache first, local cache second (so a fresh
+        # recalibration on this machine overrides the shipped numbers).
+        for path in (measured_cache_path or MEASURED_CACHE, cache_path):
+            if not path or not os.path.exists(path):
+                continue
             try:
-                with open(cache_path) as f:
-                    self._cache = json.load(f)
+                with open(path) as f:
+                    data = json.load(f)
             except Exception:
-                self._cache = {}
+                continue
+            for k, v in data.items():
+                if (isinstance(v, dict) and v.get("measured")
+                        and v.get("platform", "tpu") == target_platform):
+                    self._measured[k] = float(v["t"])
 
-    def _persist(self):
-        if self.cache_path:
-            try:
-                with open(self.cache_path, "w") as f:
-                    json.dump(self._cache, f)
-            except OSError:
-                pass
+    def _persist(self, key: str, t: float):
+        """Append one measured entry to the local cache (read-modify-write
+        so concurrent tools don't clobber each other's keys)."""
+        if not self.cache_path:
+            return
+        try:
+            data = {}
+            if os.path.exists(self.cache_path):
+                try:
+                    with open(self.cache_path) as f:
+                        data = json.load(f)
+                except Exception:
+                    data = {}
+            # drop legacy bare-float entries (pre-provenance format)
+            data = {k: v for k, v in data.items() if isinstance(v, dict)}
+            data[key] = {"t": t, "measured": True,
+                         "platform": self.target_platform}
+            with open(self.cache_path, "w") as f:
+                json.dump(data, f)
+        except OSError:
+            pass
 
     # -- shape bookkeeping -------------------------------------------------
     @staticmethod
@@ -53,56 +96,76 @@ class CostModel:
         return tuple(sz // (pc.dims[i] if i < len(pc.dims) else 1)
                      for i, sz in enumerate(dims))
 
-    @staticmethod
-    def _key(op, sub_shape, which: str) -> str:
+    def _key(self, op, pc, which: str) -> str:
+        """Cache key: op type + per-part OUTPUT and INPUT sub-shapes (+
+        attrs).  Input shapes are load-bearing: two Dense ops with the
+        same output sub-shape but different in-widths (DLRM 64→512 vs
+        512→512) cost very differently — the reference keys its timing
+        cache on the whole (op, config) pair (simulator.cc:235-253)."""
+        sub = self._sub_output_shape(op, pc)
+        ins = tuple(tuple(hi - lo + 1 for lo, hi in op.input_ranges(j, pc, 0))
+                    for j in range(len(op.inputs)))
         extra = ""
         if hasattr(op, "kernel"):
             extra = f"k{op.kernel}s{op.stride}"
         if hasattr(op, "hidden_size"):
             extra = f"h{op.hidden_size}"
-        return f"{op._type}:{sub_shape}:{extra}:{which}"
+        return (f"{op._type}:{sub}:{ins}:{extra}:"
+                f"{self.compute_dtype}:{which}")
+
+    @property
+    def _dtype_bytes(self) -> float:
+        return 2.0 if "16" in self.compute_dtype else 4.0
 
     # -- analytic roofline -------------------------------------------------
     def _analytic(self, op, pc, which: str) -> float:
         m = self.machine
         sub = self._sub_output_shape(op, pc)
-        sub_batch = sub[0]
         scale = np.prod(sub) / max(1, np.prod(op.outputs[0].dims))
         flops = op.flops_per_sample() * op.outputs[0].dims[0] * scale
-        # bytes: inputs read + outputs written for this part (activations)
+        # bytes: inputs read + weights read + outputs written for this part
         in_vol = sum(int(np.prod([hi - lo + 1 for lo, hi in op.input_ranges(j, pc, 0)]))
                      for j in range(len(op.inputs)))
-        w_vol = sum(w.volume() for w in op.weights)
+        w_vol = sum(int(np.prod([hi - lo + 1 for lo, hi in op.weight_tile(pc, wi, 0)]))
+                    for wi in range(len(op.weights)))
         out_vol = int(np.prod(sub))
-        bytes_moved = 4.0 * (in_vol + w_vol + out_vol)
+        bytes_moved = self._dtype_bytes * (in_vol + w_vol + out_vol)
         t = max(flops / (m.peak_flops * m.mxu_efficiency),
                 bytes_moved / m.hbm_bandwidth) + m.kernel_launch_overhead
         if which == "backward":
-            t *= 2.0  # dgrad + wgrad ≈ 2× forward (reference measures both)
+            t *= m.backward_multiplier  # dgrad + wgrad (fitted; default 2×)
         return float(t)
 
     # -- real measurement --------------------------------------------------
     def _measure_real(self, op, pc, which: str) -> Optional[float]:
         """Compile+time the op's forward (and backward via jax.grad) on the
-        per-part sub-shape, on the default accelerator."""
+        per-part sub-shape — per-shard WEIGHTS included (a TP-split Dense
+        is measured with its c_out/k weight slice, matching what each chip
+        would actually run) — on the default accelerator."""
         try:
+            import time as _t
+
             import jax
             import jax.numpy as jnp
             from ..ops.base import FwdCtx
 
-            sub_out = self._sub_output_shape(op, pc)
+            cdt = jnp.bfloat16 if "16" in self.compute_dtype else jnp.float32
+
             sub_ins = []
             for j, t in enumerate(op.inputs):
                 rng = op.input_ranges(j, pc, 0)
                 sub_ins.append(tuple(hi - lo + 1 for lo, hi in rng))
-            import time as _t
 
             key = jax.random.key(0)
             xs = [jnp.zeros(s, jnp.int32 if "int" in op.inputs[j].dtype
-                            else jnp.float32)
+                            else cdt)
                   for j, s in enumerate(sub_ins)]
             owner = op.share_from if op.share_from is not None else op
-            params = {w.name: jnp.zeros(w.dims, jnp.float32) for w in owner.weights}
+            params = {}
+            for wi, w in enumerate(owner.weights):
+                tile = op.weight_tile(pc, wi, 0)
+                wshape = tuple(hi - lo + 1 for lo, hi in tile) if tile else w.dims
+                params[w.name] = jnp.zeros(wshape, cdt)
             ctx = FwdCtx(training=False, rng=key,
                          stats_in={op.name: op.init_stats()} if op.init_stats() else {})
 
@@ -119,26 +182,39 @@ class CostModel:
                 fn = jax.jit(jax.value_and_grad(loss))
                 sync = lambda r: jax.device_get(r[0])
             sync(fn(params, xs))  # compile + warmup
+            # adaptive iteration count: tiny ops need many reps before the
+            # device time rises above host-dispatch noise
             n = 5
-            t0 = _t.perf_counter()
-            for _ in range(n - 1):
-                fn(params, xs)
-            sync(fn(params, xs))
-            return (_t.perf_counter() - t0) / n
-        except Exception:
+            while True:
+                t0 = _t.perf_counter()
+                for _ in range(n - 1):
+                    fn(params, xs)
+                sync(fn(params, xs))
+                dt = _t.perf_counter() - t0
+                if dt >= 0.02 or n >= 320:
+                    return dt / n
+                n *= 4
+        except Exception as e:
+            if os.environ.get("FF_COSTMODEL_DEBUG"):
+                print(f"[cost_model] measure failed for {op.name} "
+                      f"({which}): {type(e).__name__}: {e}", file=sys.stderr)
             return None
 
     # -- public ------------------------------------------------------------
     def op_time(self, op, pc, which: str) -> float:
-        sub = self._sub_output_shape(op, pc)
-        key = self._key(op, sub, which)
-        if key in self._cache:
-            return self._cache[key]
-        t = None
-        if self.measure:
+        key = self._key(op, pc, which)
+        if key in self._measured:
+            self.stats["measured_hits"] += 1
+            return self._measured[key]
+        if self.measure and key not in self._measure_failed:
             t = self._measure_real(op, pc, which)
-        if t is None:
-            t = self._analytic(op, pc, which)
-        self._cache[key] = t
-        self._persist()
-        return t
+            if t is not None:
+                self.stats["measured_runs"] += 1
+                self._measured[key] = t
+                self._persist(key, t)
+                return t
+            self._measure_failed.add(key)
+        self.stats["analytic"] += 1
+        if key not in self._analytic_memo:
+            self._analytic_memo[key] = self._analytic(op, pc, which)
+        return self._analytic_memo[key]

@@ -17,8 +17,13 @@ DCN ~25 GB/s/host) and are all overridable.
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import os
 from typing import Dict, Tuple
+
+# Roofline constants fitted to real-chip measurements by tools/calibrate.py.
+CALIBRATION_PATH = os.path.join(os.path.dirname(__file__), "machine_v5e.json")
 
 
 @dataclasses.dataclass
@@ -31,6 +36,25 @@ class TPUMachineModel:
     dcn_bandwidth: float = 25e9       # bytes/s per host
     kernel_launch_overhead: float = 2e-6   # s; XLA per-fused-region overhead
     mxu_efficiency: float = 0.45      # achievable fraction of peak for convs/matmuls
+    backward_multiplier: float = 2.0  # bwd ≈ dgrad + wgrad vs one fwd
+
+    @classmethod
+    def calibrated(cls, **kw) -> "TPUMachineModel":
+        """Machine model with roofline constants loaded from the committed
+        on-chip calibration fit (machine_v5e.json) when present — the
+        analogue of the reference replacing its three hardcoded bandwidth
+        constants with per-machine measurements.  Explicit kwargs win."""
+        if os.path.exists(CALIBRATION_PATH):
+            try:
+                with open(CALIBRATION_PATH) as f:
+                    overrides = json.load(f)
+            except Exception:
+                overrides = {}
+            names = {f.name for f in dataclasses.fields(cls)}
+            for k, v in overrides.items():
+                if k in names and k not in kw:
+                    kw[k] = v
+        return cls(**kw)
 
     def __post_init__(self):
         # near-square 2-D torus layout, the v5e topology family

@@ -112,8 +112,9 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
         return None
 
     nd = machine_model.num_devices if machine_model else model.config.num_devices
-    mm = machine_model or TPUMachineModel(num_devices=nd)
-    cost = CostModel(mm, measure=False)
+    mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
+    cost = CostModel(mm, measure=False,
+                     compute_dtype=model.config.compute_dtype)
 
     L = len(ops)
     op_index = {id(op): i for i, op in enumerate(ops)}

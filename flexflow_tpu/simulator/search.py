@@ -87,10 +87,18 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
     """Returns the best strategy map found (op name → ParallelConfig)."""
     nd = model.machine.num_devices if model.machine is not None \
         else model.config.num_devices
-    mm = machine_model or TPUMachineModel(num_devices=nd)
+    mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
     overlap = model.config.search_overlap_backward_update \
         if overlap_backward_update is None else overlap_backward_update
-    sim = Simulator(mm, CostModel(mm, measure=measure),
+    # measure=True must tag (and read) entries for the backend it actually
+    # times on; measure=False targets the shipped TPU cache regardless of
+    # the host backend (offline search on CPU-only machines).
+    import jax
+
+    platform = jax.default_backend() if measure else "tpu"
+    sim = Simulator(mm, CostModel(mm, measure=measure,
+                                  compute_dtype=model.config.compute_dtype,
+                                  target_platform=platform),
                     overlap_backward_update=overlap)
     rng = random.Random(seed)
 

@@ -1,0 +1,242 @@
+"""On-chip cost-model calibration.
+
+Closes the search-reality loop the reference closes inside its MCMC
+search (reference: simulator.cc:235-273 — every candidate's per-op time
+comes from running the REAL kernels, cached by (op, config) hash;
+conv_2d.cu:937-1039 times cudnnFind*AlgorithmEx on the actual shapes).
+On TPU a compile costs seconds, so instead of measuring inside the
+annealing loop this tool measures the whole candidate sub-shape space
+up-front on the real chip, persists the cache, and fits the roofline
+constants (mxu_efficiency, HBM bandwidth, launch overhead, backward
+multiplier) to the measurements so anything uncached is also calibrated.
+
+Usage (on a machine with the TPU attached):
+    python -m flexflow_tpu.tools.calibrate \
+        --out flexflow_tpu/simulator/measured_v5e.json \
+        --fit-out flexflow_tpu/simulator/machine_v5e.json
+
+Produces/updates:
+  * measured_v5e.json — the durable (op type, sub-shape, dtype) → seconds
+    cache every search consumes (CostModel reads it by default);
+  * machine_v5e.json — fitted TPUMachineModel overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def _model(name: str, batch_size: int, nd: int):
+    from .offline_search import build_model
+
+    return build_model(name, batch_size, nd)
+
+
+def candidate_jobs(model, nd: int, cost, full: bool) -> List[Tuple]:
+    """(op, pc, which) jobs, deduped by cache key.  ``full`` enumerates
+    the whole SOAP candidate space (what the search will cost);
+    otherwise only the data-parallel configs at nd and 1 device."""
+    from ..config import ParallelConfig
+    from ..simulator.native_search import enumerate_candidates
+
+    jobs, seen = [], set()
+
+    def add(op, pc):
+        pc = op.legalize_pc(pc)
+        for which in ("forward", "backward"):
+            key = cost._key(op, pc, which)
+            if key not in seen and key not in cost._measured:
+                seen.add(key)
+                jobs.append((op, pc, which, key))
+
+    for op in model.ops:
+        if full:
+            for pc in enumerate_candidates(op, nd):
+                add(op, pc)
+        else:
+            for parts in {nd, 1}:
+                pc = ParallelConfig.data_parallel(op.output.num_dims, parts)
+                add(op, pc.with_device_ids(tuple(range(parts))))
+    return jobs
+
+
+def run_measurements(jobs, cost, max_seconds: float, verbose: bool) -> int:
+    done = 0
+    t_start = time.time()
+    for i, (op, pc, which, key) in enumerate(jobs):
+        if time.time() - t_start > max_seconds:
+            print(f"[calibrate] time budget hit after {done}/{len(jobs)} jobs")
+            break
+        t = cost.op_time(op, pc, which)
+        done += 1
+        if verbose:
+            src = "measured" if key in cost._measured else "ANALYTIC(fallback)"
+            print(f"[{i + 1}/{len(jobs)}] {key} -> {t * 1e6:.1f} us [{src}]",
+                  flush=True)
+    return done
+
+
+def collect_fit_records(models, nds, cost) -> List[Dict]:
+    """(flops, bytes, measured fwd/bwd seconds) per measured key."""
+    import numpy as np
+
+    from ..simulator.native_search import enumerate_candidates
+
+    recs, seen = [], set()
+    for model, nd in zip(models, nds):
+        for op in model.ops:
+            for pc in enumerate_candidates(op, nd):
+                pc = op.legalize_pc(pc)
+                sub = cost._sub_output_shape(op, pc)
+                kf = cost._key(op, pc, "forward")
+                kb = cost._key(op, pc, "backward")
+                if kf in seen or kf not in cost._measured:
+                    continue
+                seen.add(kf)
+                scale = np.prod(sub) / max(1, np.prod(op.outputs[0].dims))
+                flops = op.flops_per_sample() * op.outputs[0].dims[0] * scale
+                in_vol = sum(int(np.prod([hi - lo + 1 for lo, hi
+                                          in op.input_ranges(j, pc, 0)]))
+                             for j in range(len(op.inputs)))
+                w_vol = sum(int(np.prod([hi - lo + 1 for lo, hi
+                                         in op.weight_tile(pc, wi, 0)]))
+                            for wi in range(len(op.weights)))
+                out_vol = int(np.prod(sub))
+                recs.append({
+                    "key": kf,
+                    "flops": float(flops),
+                    "bytes": cost._dtype_bytes * (in_vol + w_vol + out_vol),
+                    "t_fwd": cost._measured[kf],
+                    "t_bwd": cost._measured.get(kb),
+                })
+    return recs
+
+
+def fit_machine(recs: List[Dict], machine) -> Dict[str, float]:
+    """Grid-fit roofline constants minimizing squared log-ratio error of
+    ``max(flops/(peak·eff), bytes/(hbm·hbm_eff)) + ovh`` vs measured."""
+    import numpy as np
+
+    if not recs:
+        return {}
+    flops = np.array([r["flops"] for r in recs])
+    byts = np.array([r["bytes"] for r in recs])
+    meas = np.array([r["t_fwd"] for r in recs])
+
+    best = (None, math.inf)
+    for eff in np.arange(0.05, 1.001, 0.01):
+        for hbm_eff in np.arange(0.3, 1.001, 0.05):
+            for ovh in (1e-6, 2e-6, 4e-6, 8e-6, 16e-6, 32e-6, 64e-6):
+                pred = np.maximum(flops / (machine.peak_flops * eff),
+                                  byts / (machine.hbm_bandwidth * hbm_eff)) + ovh
+                err = float(np.mean(np.log(pred / meas) ** 2))
+                if err < best[1]:
+                    best = ((float(eff), float(hbm_eff), float(ovh)), err)
+    (eff, hbm_eff, ovh), err = best
+    ratios = [r["t_bwd"] / r["t_fwd"] for r in recs
+              if r["t_bwd"] and r["t_fwd"] > 0]
+    bwd_mult = float(np.median(ratios)) if ratios else 2.0
+    fit = {
+        "mxu_efficiency": eff,
+        "hbm_bandwidth": machine.hbm_bandwidth * hbm_eff,
+        "kernel_launch_overhead": ovh,
+        "backward_multiplier": bwd_mult,
+        "fit_log_rmse": math.sqrt(err),
+        "fit_points": len(recs),
+    }
+    return fit
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=16,
+                   help="machine size the search will target")
+    p.add_argument("--alexnet-batch", type=int, default=1024,
+                   help="global batch for the 16-chip AlexNet config "
+                        "(64/chip × 16, the reference per-GPU batch)")
+    p.add_argument("--bench-batch", type=int, default=256,
+                   help="single-chip bench batch (measured for the "
+                        "sim-vs-measured agreement check)")
+    p.add_argument("--inception", action="store_true", default=True)
+    p.add_argument("--no-inception", dest="inception", action="store_false")
+    p.add_argument("--inception-jobs", type=int, default=48,
+                   help="subsample the Inception DP job list to this many")
+    p.add_argument("--compute-dtype", default="bfloat16")
+    p.add_argument("--out", default=None,
+                   help="measured cache path (default: the packaged "
+                        "measured_v5e.json)")
+    p.add_argument("--fit-out", default=None,
+                   help="fitted machine params path (default: packaged "
+                        "machine_v5e.json)")
+    p.add_argument("--max-seconds", type=float, default=3600.0)
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ..simulator import cost_model as cm
+    from ..simulator.machine import CALIBRATION_PATH, TPUMachineModel
+
+    out = args.out or cm.MEASURED_CACHE
+    fit_out = args.fit_out or CALIBRATION_PATH
+    platform = jax.default_backend()
+    if platform != "tpu":
+        print(f"[calibrate] WARNING: measuring on {platform!r}, not TPU — "
+              "entries will be tagged accordingly and ignored by searches "
+              "targeting TPU")
+
+    mm = TPUMachineModel(num_devices=args.devices)
+    cost = cm.CostModel(mm, measure=True, cache_path=out,
+                        compute_dtype=args.compute_dtype,
+                        measured_cache_path=out, target_platform=platform)
+
+    models, nds = [], []
+    # AlexNet: full SOAP candidate space at the target machine size …
+    m = _model("alexnet", args.alexnet_batch, args.devices)
+    models.append(m)
+    nds.append(args.devices)
+    jobs = candidate_jobs(m, args.devices, cost, full=True)
+    # … plus the single-chip bench shape (agreement check) …
+    mb = _model("alexnet", args.bench_batch, 1)
+    models.append(mb)
+    nds.append(1)
+    jobs += candidate_jobs(mb, 1, cost, full=False)
+    # … plus Inception DP shapes (bench model #2).
+    if args.inception:
+        mi = _model("inception", args.bench_batch, args.devices)
+        models.append(mi)
+        nds.append(args.devices)
+        ijobs = candidate_jobs(mi, args.devices, cost, full=False)
+        if args.inception_jobs and len(ijobs) > args.inception_jobs:
+            # Even subsample: Inception entries feed the roofline fit and
+            # spot-checks, not the AlexNet SOAP search — a spread of its
+            # 94 conv shapes is enough (the fitted analytic covers the
+            # rest).
+            stride = max(1, len(ijobs) // args.inception_jobs)
+            ijobs = ijobs[::stride][:args.inception_jobs]
+        jobs += ijobs
+
+    print(f"[calibrate] {len(jobs)} measurement jobs "
+          f"(cache: {len(cost._measured)} entries pre-loaded)")
+    run_measurements(jobs, cost, args.max_seconds, verbose=not args.quiet)
+
+    recs = collect_fit_records(models, nds, cost)
+    fit = fit_machine(recs, mm)
+    if fit:
+        with open(fit_out, "w") as f:
+            json.dump(fit, f, indent=1)
+        print(f"[calibrate] fitted over {fit['fit_points']} points "
+              f"(log-rmse {fit['fit_log_rmse']:.3f}): "
+              f"mxu_eff={fit['mxu_efficiency']:.2f} "
+              f"hbm={fit['hbm_bandwidth'] / 1e9:.0f}GB/s "
+              f"ovh={fit['kernel_launch_overhead'] * 1e6:.0f}us "
+              f"bwd_mult={fit['backward_multiplier']:.2f} -> {fit_out}")
+    print(f"[calibrate] measured cache: {len(cost._measured)} entries -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -61,12 +61,15 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--devices", type=int, default=8)
     p.add_argument("--chips-per-host", type=int, default=8)
-    p.add_argument("--ici-bw", type=float, default=45e9,
-                   help="ICI bytes/s per link per direction")
-    p.add_argument("--dcn-bw", type=float, default=25e9,
-                   help="DCN bytes/s per host")
-    p.add_argument("--peak-flops", type=float, default=197e12)
-    p.add_argument("--hbm-bw", type=float, default=819e9)
+    p.add_argument("--ici-bw", type=float, default=None,
+                   help="ICI bytes/s per link per direction "
+                        "(default: calibrated/v5e)")
+    p.add_argument("--dcn-bw", type=float, default=None,
+                   help="DCN bytes/s per host (default: calibrated/v5e)")
+    p.add_argument("--peak-flops", type=float, default=None)
+    p.add_argument("--hbm-bw", type=float, default=None)
+    p.add_argument("--compute-dtype", default="bfloat16",
+                   help="dtype the cost model keys on (the bench dtype)")
     p.add_argument("--budget", type=int, default=1000,
                    help="MCMC iterations (reference default search budget)")
     p.add_argument("--alpha", type=float, default=0.05)
@@ -85,13 +88,17 @@ def main(argv: Optional[List[str]] = None):
     from ..config import ParallelConfig
 
     model = build_model(args.model, args.batch_size, args.devices)
-    mm = TPUMachineModel(num_devices=args.devices,
-                         chips_per_host=args.chips_per_host,
-                         peak_flops=args.peak_flops,
-                         hbm_bandwidth=args.hbm_bw,
-                         ici_bandwidth=args.ici_bw,
-                         dcn_bandwidth=args.dcn_bw)
-    sim = Simulator(mm, CostModel(mm, measure=False))
+    model.config.compute_dtype = args.compute_dtype
+    overrides = {k: v for k, v in [("peak_flops", args.peak_flops),
+                                   ("hbm_bandwidth", args.hbm_bw),
+                                   ("ici_bandwidth", args.ici_bw),
+                                   ("dcn_bandwidth", args.dcn_bw)]
+                 if v is not None}
+    mm = TPUMachineModel.calibrated(num_devices=args.devices,
+                                    chips_per_host=args.chips_per_host,
+                                    **overrides)
+    sim = Simulator(mm, CostModel(mm, measure=False,
+                                  compute_dtype=args.compute_dtype))
     dp = {op.name: ParallelConfig.data_parallel(op.output.num_dims, args.devices)
           .with_device_ids(tuple(range(args.devices)))
           for op in model.ops}
