@@ -9,7 +9,11 @@ Prints the reference's benchmark line:
 import sys
 import time
 
-sys.path.insert(0, ".")
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import flexflow_tpu as ff
 from flexflow_tpu.models.alexnet import build_alexnet

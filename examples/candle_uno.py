@@ -11,7 +11,11 @@ given). Prints the reference's ELAPSED TIME / THROUGHPUT line.
 import sys
 import time
 
-sys.path.insert(0, ".")
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import numpy as np
 

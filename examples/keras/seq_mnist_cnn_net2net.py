@@ -9,7 +9,11 @@ reference implements in src/runtime/model.cu:260-370.
 
 import sys
 
-sys.path.insert(0, ".")
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
 import numpy as np
 

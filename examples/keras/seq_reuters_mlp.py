@@ -7,7 +7,11 @@ Bag-of-words binary matrix over the top-N vocabulary → 512 relu →
 
 import sys
 
-sys.path.insert(0, ".")
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
 import numpy as np
 

@@ -9,7 +9,11 @@ vocab 20k; times 10 iterations and prints wall-clock.
 import sys
 import time
 
-sys.path.insert(0, ".")
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import flexflow_tpu as ff
 from flexflow_tpu.models.nmt import build_nmt, synthetic_batch

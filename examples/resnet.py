@@ -5,7 +5,11 @@
 
 import sys
 
-sys.path.insert(0, ".")
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import flexflow_tpu as ff
 from flexflow_tpu.models.resnet import build_resnet50

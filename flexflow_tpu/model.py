@@ -1102,8 +1102,12 @@ class FFModel:
     def get_parameter(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
         e = self._pack_entry(op_name, weight_name)
         if e is not None:
-            buf = np.asarray(self._params["_pipe"]["buffer"])
-            return np.asarray(self._pack_read(buf[e[0]], e))
+            # Slice the slot row on device first — fetching the whole
+            # (ring, width) buffer per accessor call would move the
+            # entire packed segment for one weight.
+            _, off, shape, n = e
+            row = self._params["_pipe"]["buffer"][e[0], off:off + n]
+            return np.asarray(row).reshape(shape)
         return np.asarray(self._params[op_name][weight_name])
 
     def set_parameter(self, op_name: str, weight_name: str, value: np.ndarray) -> None:

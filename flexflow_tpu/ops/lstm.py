@@ -52,23 +52,45 @@ class LSTM(Op):
                 raise ValueError("share_with must be an LSTM with the same hidden size")
             self.share_from = share_with
         else:
-            self._add_weight("w_ih", (e, 4 * h), DefaultWeightInitializer())
-            self._add_weight("w_hh", (h, 4 * h), DefaultWeightInitializer())
-            self._add_weight("bias", (4 * h,), ZeroInitializer())
+            # Hidden-dim tensor parallelism (config dim 2 = h of y): the
+            # 4H gate dim shards with it; w_hh's H contraction dim stays
+            # full, so each step's h is all-gathered across shards — the
+            # TPU analogue of the reference's hidden-sharded RNN Linear
+            # whose replica backward sums per-shard input grads
+            # (nmt/rnn.h:91-158, nmt/linear.cu:594-621; here GSPMD emits
+            # the all-gather/psum pair from the sharding annotations).
+            self._add_weight("w_ih", (e, 4 * h), DefaultWeightInitializer(),
+                             partition_dims=(None, 2))
+            self._add_weight("w_hh", (h, 4 * h), DefaultWeightInitializer(),
+                             partition_dims=(None, 2))
+            self._add_weight("bias", (4 * h,), ZeroInitializer(),
+                             partition_dims=(2,))
 
     def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
         x = xs[0]
         b, t, _ = x.shape
-        h = self.hidden_size
+        # h from the weight (not self.hidden_size): the simulator measures
+        # per-shard sub-shapes by feeding sliced weights.
+        h = params["w_ih"].shape[1] // 4
         dt = x.dtype
         acc = jnp.float32 if dt == jnp.bfloat16 else None
         w_ih = params["w_ih"].astype(dt)
         w_hh = params["w_hh"].astype(dt)
         bias = params["bias"].astype(jnp.float32)
+        # Under GSPMD h == H_full (logical shapes; the hidden split is a
+        # sharding annotation).  h < H_full only when the simulator times
+        # a PER-SHARD slice (weight_tile-sized arrays): then the h carry
+        # is kept at H_full and each step's shard output is tiled back up,
+        # standing in for the per-step all-gather the real TP execution
+        # performs — the values are meaningless but the matmul shapes and
+        # the gather volume match what one shard computes.
+        H_full = w_hh.shape[0]
         if self.has_state_inputs:
             h0, c0 = xs[1].astype(jnp.float32), xs[2].astype(jnp.float32)
+            if h != H_full:
+                c0 = c0[:, :h]
         else:
-            h0 = jnp.zeros((b, h), jnp.float32)
+            h0 = jnp.zeros((b, H_full), jnp.float32)
             c0 = jnp.zeros((b, h), jnp.float32)
 
         # One big input projection over all timesteps (B·T on the MXU rows).
@@ -80,22 +102,37 @@ class LSTM(Op):
             h_prev, c_prev = carry
             z = xz_t + jnp.dot(h_prev.astype(dt), w_hh,
                                preferred_element_type=acc).astype(jnp.float32)
-            i, f, g, o = jnp.split(z, 4, axis=-1)
+            # (B, 4, H) so each gate's H dim carries the same sharding
+            # under hidden-TP (a flat 4H split would straddle gates).
+            z = z.reshape(z.shape[0], 4, h)
+            i, f, g, o = z[:, 0], z[:, 1], z[:, 2], z[:, 3]
             c_new = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
             h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
-            return (h_new, c_new), h_new
+            h_next = (h_new if h == H_full
+                      else jnp.tile(h_new, (1, H_full // h)))
+            return (h_next, c_new), h_new
 
-        (h_t, c_t), ys = lax.scan(step, (h0, c0), xz)
+        (_, c_t), ys = lax.scan(step, (h0, c0), xz)
         y = jnp.swapaxes(ys, 0, 1).astype(dt)  # (B, T, H)
-        return [y, h_t.astype(dt), c_t.astype(dt)]
+        return [y, ys[-1].astype(dt), c_t.astype(dt)]
 
     def flops_per_sample(self):
         _, t, e = self.inputs[0].dims
         h = self.hidden_size
         return 2.0 * t * (e + h) * 4 * h
 
+    def _config_dim_bound(self, i: int):
+        """Time (dim 1) never splits — the recurrence is sequential; the
+        hidden split (dim 2) must divide H."""
+        if i == 1:
+            return 1
+        return super()._config_dim_bound(i)
+
     def input_ranges(self, j, pc, part_idx):
-        """Batch-tiled only: the recurrence needs the full time extent."""
+        """Batch-tiled only; every hidden shard reads the full input
+        features and full h0/c0 (the w_hh contraction needs all of H —
+        the reference replicates the RNN Linear input per shard the same
+        way, nmt/linear.cu:174-185)."""
         in_dims = self.inputs[j].dims
         b_lo, b_hi = self.output_tile(pc, part_idx)[0]
         return [(b_lo, b_hi)] + [(0, s - 1) for s in in_dims[1:]]

@@ -20,7 +20,7 @@ import numpy as np
 from ..config import ParallelConfig
 from .cost_model import CostModel
 from .machine import TPUMachineModel
-from .search import _SPLITTABLE, _divisors
+from .search import _divisors, splittable_dims
 
 
 def _factorizations(n: int, dims_avail: List[int], out_dims) -> List[Tuple[int, ...]]:
@@ -51,8 +51,7 @@ def enumerate_candidates(op, nd: int) -> List[ParallelConfig]:
     search samples randomly (search.py random_parallel_config), plus
     block-aligned placements for sub-machine configs."""
     rank = op.output.num_dims
-    splittable = [d for d in _SPLITTABLE.get(op._type, (0,))
-                  if d < rank]
+    splittable = list(splittable_dims(op))
     seen = set()
     cands: List[ParallelConfig] = []
     for n in _divisors(nd):

@@ -33,11 +33,13 @@ def _divisors(n: int) -> List[int]:
 
 # Per-op-type partitionable dims (natural order, batch first / NHWC).
 # Mirrors which Legion task-grid dims each reference op actually splits.
+# "last" marks the output channel dim (rank-dependent: a Dense on (B, C)
+# splits dim 1, on (B, T, C) dim 2 — linear.cu tensor parallelism).
 _SPLITTABLE = {
     "Conv2D": (0, 1, 2),       # n, h, w (reference asserts c unsplit, conv_2d.cu:203)
     "Pool2D": (0, 1, 2),
-    "Dense": (0, 1),           # n, c_out (linear.cu tensor parallelism)
-    "Embedding": (0, 1),       # n, out_dim
+    "Dense": (0, "last"),      # n, c_out (linear.cu tensor parallelism)
+    "Embedding": (0, "last"),  # n, out_dim
     "Concat": (0,),
     "Flat": (0,),
     "Softmax": (0,),           # sample only (softmax.cu asserts)
@@ -45,16 +47,28 @@ _SPLITTABLE = {
     "Dropout": (0,),
     "ElementUnary": (0,),
     "ElementBinary": (0,),
-    "LSTM": (0,),              # batch only: recurrence over T
+    "LSTM": (0, 2),            # batch + hidden TP (T stays sequential)
     "MSELoss": (0,),
     "PipelineMLP": (0, 1),     # dim 1 = pipeline (operator-dim) degree
 }
 
 
+def splittable_dims(op) -> tuple:
+    """Resolve _SPLITTABLE for this op's actual output rank."""
+    rank = op.output.num_dims
+    dims = _SPLITTABLE.get(op._type, (0,))
+    out = []
+    for d in dims:
+        d = rank - 1 if d == "last" else d
+        if 0 <= d < rank and d not in out:
+            out.append(d)
+    return tuple(out)
+
+
 def random_parallel_config(op, num_devices: int, rng: random.Random) -> ParallelConfig:
     """Random legal SOAP config for ``op`` over ``num_devices`` chips."""
     rank = op.output.num_dims
-    splittable = _SPLITTABLE.get(op._type, (0,))
+    splittable = splittable_dims(op)
     num_parts = rng.choice(_divisors(num_devices))
     # randomly factor num_parts across splittable dims
     degrees = [1] * rank

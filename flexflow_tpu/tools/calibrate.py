@@ -226,6 +226,12 @@ def main(argv: Optional[List[str]] = None):
 
     recs = collect_fit_records(models, nds, cost)
     fit = fit_machine(recs, mm)
+    if fit and platform != "tpu" and args.fit_out is None:
+        # Never let a CPU-host dry run overwrite the packaged TPU fit —
+        # TPUMachineModel.calibrated() has no platform filter of its own.
+        print(f"[calibrate] NOT writing machine fit: measured on "
+              f"{platform!r}; pass --fit-out explicitly to keep it")
+        fit = {}
     if fit:
         with open(fit_out, "w") as f:
             json.dump(fit, f, indent=1)

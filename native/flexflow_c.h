@@ -167,6 +167,205 @@ int flexflow_model_load(flexflow_model_t m, const char* path);
 /* tensor introspection */
 int flexflow_tensor_get_dims(flexflow_tensor_t t, int* dims /*>=4 slots*/);
 
+/* ----------------------------------------------------------------------
+ * Extended surface: parity with the reference C API
+ * (reference: python/flexflow_c.h:27-718 — config accessors, optimizer /
+ * initializer / NetConfig objects, dataloader handles, tensor raw-ptr
+ * attach + inline map, op handles and the deferred-shape builders).
+ * -------------------------------------------------------------------- */
+
+typedef struct flexflow_sgd_optimizer_t { void* impl; } flexflow_sgd_optimizer_t;
+typedef struct flexflow_adam_optimizer_t { void* impl; } flexflow_adam_optimizer_t;
+typedef struct flexflow_initializer_t { void* impl; } flexflow_initializer_t;
+typedef struct flexflow_glorot_uniform_initializer_t { void* impl; } flexflow_glorot_uniform_initializer_t;
+typedef struct flexflow_zero_initializer_t { void* impl; } flexflow_zero_initializer_t;
+typedef struct flexflow_uniform_initializer_t { void* impl; } flexflow_uniform_initializer_t;
+typedef struct flexflow_norm_initializer_t { void* impl; } flexflow_norm_initializer_t;
+typedef struct flexflow_net_config_t { void* impl; } flexflow_net_config_t;
+typedef struct flexflow_op_t { void* impl; } flexflow_op_t;
+typedef struct flexflow_parameter_t { void* impl; } flexflow_parameter_t;
+typedef struct flexflow_perf_metrics_t { void* impl; } flexflow_perf_metrics_t;
+typedef struct flexflow_dataloader_4d_t { void* impl; } flexflow_dataloader_4d_t;
+typedef struct flexflow_dataloader_2d_t { void* impl; } flexflow_dataloader_2d_t;
+typedef struct flexflow_single_dataloader_t { void* impl; } flexflow_single_dataloader_t;
+
+/* config accessors (reference: flexflow_config_get_*) */
+int flexflow_config_parse_args(flexflow_config_t c, int argc, char** argv);
+int flexflow_config_parse_args_default(flexflow_config_t c);
+int flexflow_config_get_batch_size(flexflow_config_t c);
+int flexflow_config_get_epochs(flexflow_config_t c);
+int flexflow_config_get_num_nodes(flexflow_config_t c);
+int flexflow_config_get_workers_per_node(flexflow_config_t c);
+
+/* optimizer objects (reference: optimizer.cc semantics) */
+flexflow_sgd_optimizer_t flexflow_sgd_optimizer_create(
+    flexflow_model_t m, double lr, double momentum, int nesterov,
+    double weight_decay);
+void flexflow_sgd_optimizer_destroy(flexflow_sgd_optimizer_t o);
+void flexflow_sgd_optimizer_set_lr(flexflow_sgd_optimizer_t o, double lr);
+flexflow_adam_optimizer_t flexflow_adam_optimizer_create(
+    flexflow_model_t m, double alpha, double beta1, double beta2,
+    double weight_decay, double epsilon);
+void flexflow_adam_optimizer_destroy(flexflow_adam_optimizer_t o);
+void flexflow_adam_optimizer_set_lr(flexflow_adam_optimizer_t o, double lr);
+/* bind for the next compile; pass optimizer="" to flexflow_model_compile */
+int flexflow_model_set_sgd_optimizer(flexflow_model_t m,
+                                     flexflow_sgd_optimizer_t o);
+int flexflow_model_set_adam_optimizer(flexflow_model_t m,
+                                      flexflow_adam_optimizer_t o);
+
+/* initializer objects (reference: initializer.h:26-100) */
+flexflow_initializer_t flexflow_initializer_create_null(void);
+flexflow_glorot_uniform_initializer_t
+flexflow_glorot_uniform_initializer_create(int seed);
+void flexflow_glorot_uniform_initializer_destroy(
+    flexflow_glorot_uniform_initializer_t i);
+flexflow_zero_initializer_t flexflow_zero_initializer_create(void);
+void flexflow_zero_initializer_destroy(flexflow_zero_initializer_t i);
+flexflow_uniform_initializer_t flexflow_uniform_initializer_create(
+    int seed, float min_val, float max_val);
+void flexflow_uniform_initializer_destroy(flexflow_uniform_initializer_t i);
+flexflow_norm_initializer_t flexflow_norm_initializer_create(
+    int seed, float mean, float stddev);
+void flexflow_norm_initializer_destroy(flexflow_norm_initializer_t i);
+
+/* builder variants taking initializer handles (pass {NULL} for default) */
+flexflow_tensor_t flexflow_model_add_dense_v2(
+    flexflow_model_t m, flexflow_tensor_t input, int out_dim, int activation,
+    int use_bias, flexflow_initializer_t kernel_init,
+    flexflow_initializer_t bias_init, const char* name);
+flexflow_tensor_t flexflow_model_add_conv2d_v2(
+    flexflow_model_t m, flexflow_tensor_t input, int out_channels,
+    int kernel_h, int kernel_w, int stride_h, int stride_w, int padding_h,
+    int padding_w, int activation, int use_bias,
+    flexflow_initializer_t kernel_init, flexflow_initializer_t bias_init,
+    const char* name);
+
+/* NetConfig (reference: --dataset flag carrier) */
+flexflow_net_config_t flexflow_net_config_create(void);
+void flexflow_net_config_destroy(flexflow_net_config_t c);
+const char* flexflow_net_config_get_dataset_path(flexflow_net_config_t c);
+
+/* deferred-shape (functional) builders: create the op descriptor now,
+ * bind the input later (reference: *_no_inout + op_init_inout) */
+flexflow_op_t flexflow_model_add_conv2d_no_inout(
+    flexflow_model_t m, int out_channels, int kernel_h, int kernel_w,
+    int stride_h, int stride_w, int padding_h, int padding_w, int activation,
+    int use_bias, const char* name);
+flexflow_op_t flexflow_model_add_dense_no_inout(
+    flexflow_model_t m, int out_dim, int activation, int use_bias,
+    const char* name);
+flexflow_op_t flexflow_model_add_pool2d_no_inout(
+    flexflow_model_t m, int kernel_h, int kernel_w, int stride_h,
+    int stride_w, int padding_h, int padding_w, int pool_max,
+    const char* name);
+flexflow_op_t flexflow_model_add_flat_no_inout(flexflow_model_t m,
+                                               const char* name);
+flexflow_tensor_t flexflow_op_init_inout(flexflow_op_t op, flexflow_model_t m,
+                                         flexflow_tensor_t input);
+int flexflow_op_add_to_model(flexflow_op_t op, flexflow_model_t m);
+int flexflow_op_init(flexflow_op_t op, flexflow_model_t m);
+int flexflow_op_forward(flexflow_op_t op, flexflow_model_t m);
+
+/* op / parameter handles (reference: model_get_layer_by_id etc.) */
+int flexflow_model_get_num_layers(flexflow_model_t m);
+flexflow_op_t flexflow_model_get_layer_by_id(flexflow_model_t m, int id);
+void flexflow_op_destroy(flexflow_op_t op);
+flexflow_tensor_t flexflow_op_get_input_by_id(flexflow_op_t op, int id);
+flexflow_tensor_t flexflow_op_get_output_by_id(flexflow_op_t op, int id);
+flexflow_parameter_t flexflow_op_get_parameter_by_id(flexflow_op_t op, int id);
+flexflow_parameter_t flexflow_model_get_parameter_by_id(flexflow_model_t m,
+                                                        int id);
+void flexflow_parameter_destroy(flexflow_parameter_t p);
+int64_t flexflow_parameter_get_volume_v2(flexflow_parameter_t p);
+int flexflow_parameter_get_weights_float(flexflow_parameter_t p, float* out,
+                                         int64_t count);
+int flexflow_parameter_set_weights_float(flexflow_parameter_t p,
+                                         const float* data, int64_t count);
+
+/* label tensor + layer printing */
+flexflow_tensor_t flexflow_model_get_label_tensor(flexflow_model_t m);
+void flexflow_model_print_layers(flexflow_model_t m, int id /* -1 = all */);
+int flexflow_model_prefetch(flexflow_model_t m);
+
+/* perf metrics handle (reference: model_get_perf_metrics +
+ * per_metrics_get_accuracy; the short "per_metrics" spelling matches the
+ * reference header) */
+flexflow_perf_metrics_t flexflow_model_get_perf_metrics(flexflow_model_t m);
+void flexflow_per_metrics_destroy(flexflow_perf_metrics_t p);
+float flexflow_per_metrics_get_accuracy(flexflow_perf_metrics_t p);
+int flexflow_model_compute_metrics(flexflow_model_t m);
+
+/* tracing + timing (reference: begin/end_trace replay Legion traces; the
+ * fused jitted step is traced once by XLA, so these are semantic no-ops
+ * kept for source compatibility) */
+void flexflow_begin_trace(flexflow_model_t m, int trace_id);
+void flexflow_end_trace(flexflow_model_t m, int trace_id);
+double flexflow_get_current_time(flexflow_model_t m); /* microseconds */
+
+/* raw-pointer attach (reference: Tensor::attach_raw_ptr model.cc:73-93 —
+ * zero-copy host data; here the pointer is wrapped as a numpy view and
+ * becomes the tensor's host-resident data) */
+int flexflow_tensor_attach_raw_ptr(flexflow_model_t m, flexflow_tensor_t t,
+                                   void* ptr, int64_t count,
+                                   int is_float /*1=f32 0=i32*/);
+int flexflow_tensor_detach_raw_ptr(flexflow_model_t m, flexflow_tensor_t t);
+/* inline map: materialize the tensor's current host data (attached or
+ * staged) and expose the raw pointer */
+int flexflow_tensor_inline_map(flexflow_model_t m, flexflow_tensor_t t);
+void flexflow_tensor_inline_unmap(flexflow_model_t m, flexflow_tensor_t t);
+int flexflow_tensor_is_mapped(flexflow_model_t m, flexflow_tensor_t t);
+float* flexflow_tensor_get_raw_ptr_float(flexflow_model_t m,
+                                         flexflow_tensor_t t);
+int32_t* flexflow_tensor_get_raw_ptr_int32(flexflow_model_t m,
+                                           flexflow_tensor_t t);
+int flexflow_tensor_get_num_dims(flexflow_tensor_t t);
+int flexflow_tensor_get_data_type(flexflow_tensor_t t); /* 0=f32 1=i32 2=i64 */
+flexflow_op_t flexflow_tensor_get_owner_op(flexflow_tensor_t t);
+
+/* dataloader handles (reference: flexflow_dataloader_{4d,2d} +
+ * single_dataloader — full dataset host-resident, per-step batch scatter).
+ * create: input + label arrays together; create_v2: one tensor's data only. */
+flexflow_dataloader_4d_t flexflow_dataloader_4d_create(
+    flexflow_model_t m, flexflow_tensor_t input, const float* full_input,
+    const int32_t* full_label, int64_t num_samples);
+flexflow_dataloader_4d_t flexflow_dataloader_4d_create_v2(
+    flexflow_model_t m, flexflow_tensor_t input, const float* full_input,
+    int64_t num_samples);
+void flexflow_dataloader_4d_destroy(flexflow_dataloader_4d_t d);
+void flexflow_dataloader_4d_reset(flexflow_dataloader_4d_t d);
+int flexflow_dataloader_4d_next_batch(flexflow_dataloader_4d_t d,
+                                      flexflow_model_t m);
+int64_t flexflow_dataloader_4d_get_num_samples(flexflow_dataloader_4d_t d);
+void flexflow_dataloader_4d_set_num_samples(flexflow_dataloader_4d_t d,
+                                            int64_t n);
+flexflow_dataloader_2d_t flexflow_dataloader_2d_create(
+    flexflow_model_t m, flexflow_tensor_t input, const float* full_input,
+    const int32_t* full_label, int64_t num_samples);
+flexflow_dataloader_2d_t flexflow_dataloader_2d_create_v2(
+    flexflow_model_t m, flexflow_tensor_t input, const float* full_input,
+    int64_t num_samples);
+void flexflow_dataloader_2d_destroy(flexflow_dataloader_2d_t d);
+void flexflow_dataloader_2d_reset(flexflow_dataloader_2d_t d);
+int flexflow_dataloader_2d_next_batch(flexflow_dataloader_2d_t d,
+                                      flexflow_model_t m);
+int64_t flexflow_dataloader_2d_get_num_samples(flexflow_dataloader_2d_t d);
+void flexflow_dataloader_2d_set_num_samples(flexflow_dataloader_2d_t d,
+                                            int64_t n);
+/* any-rank, any-dtype single-tensor loader (reference: SingleDataLoader) */
+flexflow_single_dataloader_t flexflow_single_dataloader_create(
+    flexflow_model_t m, flexflow_tensor_t t, const void* full_data,
+    int64_t num_samples, int is_float /*1=f32 0=i32*/,
+    int is_label /*feed as label instead of input*/);
+void flexflow_single_dataloader_destroy(flexflow_single_dataloader_t d);
+void flexflow_single_dataloader_reset(flexflow_single_dataloader_t d);
+int flexflow_single_dataloader_next_batch(flexflow_single_dataloader_t d,
+                                          flexflow_model_t m);
+int64_t flexflow_single_dataloader_get_num_samples(
+    flexflow_single_dataloader_t d);
+void flexflow_single_dataloader_set_num_samples(flexflow_single_dataloader_t d,
+                                                int64_t n);
+
 #ifdef __cplusplus
 }
 #endif

@@ -108,6 +108,152 @@ int main(void) {
   flexflow_model_destroy(m2);
   flexflow_config_destroy(cfg2);
 
+  /* ---- extended surface (reference parity) ------------------------- */
+
+  /* config accessors + optimizer/initializer objects + builders _v2 +
+     deferred (functional) builders + dataloaders + attach/inline-map */
+  flexflow_config_t cfg3 = flexflow_config_create(16, 2, 0);
+  assert(flexflow_config_parse_args_default(cfg3) == 0);
+  assert(flexflow_config_get_batch_size(cfg3) == 16);
+  assert(flexflow_config_get_epochs(cfg3) == 2);
+  assert(flexflow_config_get_num_nodes(cfg3) >= 1);
+  assert(flexflow_config_get_workers_per_node(cfg3) >= 1);
+
+  flexflow_model_t m3 = flexflow_model_create(cfg3);
+  int d3[2] = {16, 8};
+  flexflow_tensor_t in3 = flexflow_tensor_create(m3, 2, d3, "float32");
+  assert(flexflow_tensor_get_num_dims(in3) == 2);
+  assert(flexflow_tensor_get_data_type(in3) == 0);
+
+  flexflow_glorot_uniform_initializer_t gi =
+      flexflow_glorot_uniform_initializer_create(3);
+  flexflow_zero_initializer_t zi = flexflow_zero_initializer_create();
+  flexflow_initializer_t ki = {gi.impl};
+  flexflow_initializer_t bi = {zi.impl};
+  flexflow_tensor_t t3 =
+      flexflow_model_add_dense_v2(m3, in3, 32, 1, 1, ki, bi, "v2fc1");
+  assert(t3.impl != NULL);
+
+  /* deferred-shape (functional) builder: dense bound to its input later */
+  flexflow_op_t dop = flexflow_model_add_dense_no_inout(m3, 4, 0, 1, "v2fc2");
+  flexflow_tensor_t t4 = flexflow_op_init_inout(dop, m3, t3);
+  assert(t4.impl != NULL);
+  assert(flexflow_op_add_to_model(dop, m3) == 0);
+  flexflow_tensor_t t4b = flexflow_op_get_output_by_id(dop, 0);
+  assert(t4b.impl != NULL);
+  flexflow_tensor_t sm3 = flexflow_model_add_softmax(m3, t4, "v2sm");
+  assert(sm3.impl != NULL);
+
+  /* optimizer object bound ahead of compile (optimizer="") */
+  flexflow_sgd_optimizer_t sgd =
+      flexflow_sgd_optimizer_create(m3, 0.1, 0.0, 0, 0.0);
+  flexflow_sgd_optimizer_set_lr(sgd, 0.5);
+  assert(flexflow_model_set_sgd_optimizer(m3, sgd) == 0);
+  const char* mets3[] = {"accuracy"};
+  assert(flexflow_model_compile(m3, "", 0.0,
+                                "sparse_categorical_crossentropy", mets3,
+                                1) == 0);
+  assert(flexflow_model_init_layers(m3) == 0);
+  assert(flexflow_model_get_num_layers(m3) == 3);
+
+  /* op + parameter handles */
+  flexflow_op_t l0 = flexflow_model_get_layer_by_id(m3, 0);
+  flexflow_tensor_t l0in = flexflow_op_get_input_by_id(l0, 0);
+  flexflow_tensor_t l0out = flexflow_op_get_output_by_id(l0, 0);
+  assert(l0in.impl && l0out.impl);
+  flexflow_op_t owner = flexflow_tensor_get_owner_op(l0out);
+  assert(owner.impl != NULL);
+  flexflow_parameter_t k0 = flexflow_op_get_parameter_by_id(l0, 0);
+  assert(k0.impl != NULL);
+  assert(flexflow_parameter_get_volume_v2(k0) == 8 * 32);
+  float* wv = (float*)malloc(sizeof(float) * 8 * 32);
+  assert(flexflow_parameter_get_weights_float(k0, wv, 8 * 32) == 0);
+  assert(flexflow_parameter_set_weights_float(k0, wv, 8 * 32) == 0);
+  free(wv);
+  flexflow_parameter_t p0 = flexflow_model_get_parameter_by_id(m3, 0);
+  assert(p0.impl != NULL);
+
+  /* label tensor exists post-compile */
+  flexflow_tensor_t lbl = flexflow_model_get_label_tensor(m3);
+  assert(lbl.impl != NULL);
+  flexflow_model_print_layers(m3, -1);
+  assert(flexflow_model_prefetch(m3) == 0);
+
+  /* dataloaders: full dataset host-resident, per-step slice staging */
+  enum { NS = 64 };
+  static float xs3[NS * 8];
+  static int32_t ys3[NS];
+  for (int i = 0; i < NS; i++) {
+    int best = 0;
+    for (int j = 0; j < 8; j++) {
+      xs3[i * 8 + j] = (float)rand() / RAND_MAX - 0.5f;
+      if (j < 4 && xs3[i * 8 + j] > xs3[i * 8 + best]) best = j;
+    }
+    ys3[i] = best;
+  }
+  flexflow_dataloader_2d_t dl =
+      flexflow_dataloader_2d_create(m3, in3, xs3, ys3, NS);
+  assert(dl.impl != NULL);
+  assert(flexflow_dataloader_2d_get_num_samples(dl) == NS);
+  flexflow_dataloader_2d_set_num_samples(dl, NS);
+  flexflow_dataloader_2d_reset(dl);
+  double t_start = flexflow_get_current_time(m3);
+  for (int e = 0; e < 2; e++) {
+    flexflow_begin_trace(m3, 111);
+    for (int it = 0; it < NS / 16; it++) {
+      assert(flexflow_dataloader_2d_next_batch(dl, m3) == 0);
+      assert(flexflow_model_train_iteration(m3) == 0);
+    }
+    flexflow_end_trace(m3, 111);
+  }
+  assert(flexflow_model_sync(m3) == 0);
+  assert(flexflow_get_current_time(m3) > t_start);
+  assert(flexflow_model_compute_metrics(m3) == 0);
+  flexflow_perf_metrics_t pm = flexflow_model_get_perf_metrics(m3);
+  assert(pm.impl != NULL);
+  float acc3 = flexflow_per_metrics_get_accuracy(pm);
+  printf("C API extended: dataloader-trained accuracy %.2f%%\n", acc3);
+  assert(acc3 > 30.0f);
+  flexflow_per_metrics_destroy(pm);
+  flexflow_dataloader_2d_destroy(dl);
+
+  /* attach_raw_ptr (zero-copy numpy view) + single dataloader + inline map */
+  assert(flexflow_tensor_attach_raw_ptr(m3, in3, xs3, NS * 8, 1) == 0);
+  flexflow_single_dataloader_t sdl =
+      flexflow_single_dataloader_create(m3, in3, NULL, NS, 1, 0);
+  assert(sdl.impl != NULL);
+  assert(flexflow_single_dataloader_get_num_samples(sdl) == NS);
+  assert(flexflow_single_dataloader_next_batch(sdl, m3) == 0);
+  assert(flexflow_tensor_inline_map(m3, in3) == 0);
+  assert(flexflow_tensor_is_mapped(m3, in3) == 1);
+  float* raw = flexflow_tensor_get_raw_ptr_float(m3, in3);
+  assert(raw != NULL);
+  assert(raw[0] == xs3[0]);  /* attached view aliases the caller's memory */
+  flexflow_tensor_inline_unmap(m3, in3);
+  assert(flexflow_tensor_is_mapped(m3, in3) == 0);
+  assert(flexflow_tensor_detach_raw_ptr(m3, in3) == 0);
+  flexflow_single_dataloader_destroy(sdl);
+
+  /* adam object + net config */
+  flexflow_adam_optimizer_t adam =
+      flexflow_adam_optimizer_create(m3, 0.001, 0.9, 0.999, 0.0, 1e-8);
+  flexflow_adam_optimizer_set_lr(adam, 0.002);
+  assert(flexflow_model_set_adam_optimizer(m3, adam) == 0);
+  flexflow_adam_optimizer_destroy(adam);
+  flexflow_net_config_t nc = flexflow_net_config_create();
+  assert(flexflow_net_config_get_dataset_path(nc) != NULL);
+  flexflow_net_config_destroy(nc);
+
+  flexflow_sgd_optimizer_destroy(sgd);
+  flexflow_glorot_uniform_initializer_destroy(gi);
+  flexflow_zero_initializer_destroy(zi);
+  flexflow_op_destroy(l0);
+  flexflow_op_destroy(owner);
+  flexflow_parameter_destroy(k0);
+  flexflow_parameter_destroy(p0);
+  flexflow_model_destroy(m3);
+  flexflow_config_destroy(cfg3);
+
   printf("C API smoke test: OK\n");
   return 0;
 }

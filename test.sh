@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Test-suite driver — the analogue of the reference's python/test.sh
+# (which runs ~30 flexflow_python example invocations as the de-facto
+# suite).  Here: the pytest suite on a virtual 8-device CPU mesh, then
+# (with RUN_EXAMPLES=1) the example apps with VerifyMetrics assertions.
+set -e
+cd "$(dirname "$0")"
+
+python -m pytest tests/ -q "$@"
+
+if [ -n "$RUN_EXAMPLES" ]; then
+  for ex in examples/mnist_mlp_native.py \
+            examples/keras/seq_mnist_mlp.py \
+            examples/keras/func_mnist_mlp_concat.py; do
+    echo "== $ex"
+    python "$ex" -e 1 -b 64
+  done
+fi
+echo "test.sh: OK"

@@ -1,0 +1,75 @@
+"""16-device virtual-mesh coverage (BASELINE configs #3/#4 shapes).
+
+The main suite runs on an 8-device mesh (conftest); the device count is
+baked into the XLA CPU client at init, so 16-device coverage runs in
+subprocesses with their own XLA_FLAGS.  Covers the two BASELINE configs
+that specify 16 cores: AlexNet-style SOAP hybrid (via dryrun_multichip)
+and NMT at reference size (hidden 2048, vocab 20k — nmt/nmt.cc:34-44)
+with hidden-TP LSTM over a dp4×tp4 mesh.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run16(code: str, timeout=900):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    prologue = "import jax; jax.config.update('jax_platforms','cpu')\n"
+    return subprocess.run([sys.executable, "-c", prologue + code],
+                          cwd=_ROOT, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_16():
+    r = _run16(
+        "import importlib.util\n"
+        "spec = importlib.util.spec_from_file_location('ge', '__graft_entry__.py')\n"
+        "ge = importlib.util.module_from_spec(spec); spec.loader.exec_module(ge)\n"
+        "ge.dryrun_multichip(16)\n")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dryrun_multichip(16): pipeline ok" in r.stdout
+
+
+@pytest.mark.slow
+def test_nmt_reference_size_16dev():
+    """One NMT train step at the reference config (2 layers, seq 20,
+    hidden=embed=2048, vocab 20480) on 16 virtual devices, dp4 x tp4."""
+    r = _run16("""
+import sys
+sys.path.insert(0, '.')
+import numpy as np
+import flexflow_tpu as ff
+from flexflow_tpu.models.nmt import build_nmt, synthetic_batch
+
+B, T, H, V = 16, 20, 2048, 20480
+tp = {}
+for n in ('embed_src', 'embed_dst'):
+    tp[n] = ff.ParallelConfig(dims=(4, 1, 4))
+for n in ('enc_lstm0', 'enc_lstm1', 'dec_lstm0', 'dec_lstm1'):
+    tp[n] = ff.ParallelConfig(dims=(4, 1, 4))
+tp['vocab_proj'] = ff.ParallelConfig(dims=(4, 1, 4))
+tp['softmax_dp'] = ff.ParallelConfig(dims=(16, 1, 1))
+cfg = ff.FFConfig(batch_size=B, strategies=tp)
+m = ff.FFModel(cfg)
+src, dst, _ = build_nmt(m, B, seq_length=T, num_layers=2,
+                        hidden_size=H, embed_size=H, vocab_size=V)
+m.compile(ff.SGDOptimizer(lr=0.1), 'sparse_categorical_crossentropy',
+          ['accuracy'])
+m.init_layers(seed=1)
+s, d, l = synthetic_batch(B, T, V)
+m.set_batch({src: s, dst: d}, l)
+m.train_iteration()
+m.sync()
+spec = m._params['enc_lstm0']['w_ih'].sharding.spec
+assert len(spec) >= 2 and spec[1] is not None, spec
+print('nmt16: ok', spec)
+""", timeout=1500)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "nmt16: ok" in r.stdout
