@@ -1,14 +1,21 @@
-"""Benchmark driver: AlexNet training throughput on the available TPU.
+"""Benchmark driver: AlexNet + InceptionV3 training throughput and MFU
+on the attached TPU.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-Baseline derivation (BASELINE.md): the reference repo records no numbers;
-the driver-defined target is "v5e-16 >= 4x V100 + NCCL" on AlexNet.  A
-V100 trains reference-config AlexNet (bs 64/gpu, 3x229x229, f32, cuDNN) at
-~1.5k samples/s, so 4xV100 ~= 6k samples/s and the per-chip parity bar on
-a 16-chip pod is 6000/16 = 375 samples/s/chip.  vs_baseline reported here
-is measured samples/s/chip divided by that 375 bar.
+Primary metric (continuity with earlier rounds): AlexNet samples/s/chip
+against the 375 samples/s/chip parity bar.  Baseline derivation
+(BASELINE.md): the reference repo records no numbers; the driver-defined
+target is "v5e-16 >= 4x V100 + NCCL".  A V100 trains reference-config
+AlexNet (bs 64/gpu, 3x229x229, f32, cuDNN) at ~1.5k samples/s, so 4xV100
+~= 6k samples/s and the per-chip parity bar on a 16-chip pod is
+6000/16 = 375 samples/s/chip.
+
+``extra`` carries the round-3 additions: per-model samples/s/chip,
+achieved TFLOPS and MFU (vs 197 TFLOP/s bf16 peak on v5e; train-step
+FLOPs estimated as 3x forward — dgrad + wgrad ≈ 2 fwd, the reference's
+own backward accounting), plus a fused-Pallas-optimizer on-chip check.
 """
 
 import json
@@ -17,43 +24,57 @@ import time
 
 sys.path.insert(0, ".")
 
-PER_CHIP_BASELINE = 375.0  # samples/s/chip parity bar (see module docstring)
+PER_CHIP_BASELINE = 375.0  # samples/s/chip parity bar (see docstring)
+PEAK_FLOPS = 197e12        # v5e bf16
 
 
-def run(batch_size=256, epochs=3, iters_per_epoch=8, compute_dtype="bfloat16"):
-    import jax
-
-    jax.config.update("jax_compilation_cache_dir", "/tmp/flexflow_tpu_jax_cache")
-
+def _build(name, batch_size, compute_dtype, fused=False):
     import flexflow_tpu as ff
-    from flexflow_tpu.models.alexnet import build_alexnet
 
-    n_dev = len(jax.devices())
-    cfg = ff.FFConfig(batch_size=batch_size, compute_dtype=compute_dtype)
+    cfg = ff.FFConfig(batch_size=batch_size, compute_dtype=compute_dtype,
+                      fused_optimizer=fused)
     model = ff.FFModel(cfg)
-    inp, _ = build_alexnet(model, cfg.batch_size)
+    if name == "alexnet":
+        from flexflow_tpu.models.alexnet import build_alexnet
+        inp, _ = build_alexnet(model, batch_size)
+    else:
+        from flexflow_tpu.models.inception import build_inception_v3
+        inp, _ = build_inception_v3(model, batch_size)
     model.compile(ff.SGDOptimizer(model, lr=0.001),
                   ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                   [ff.MetricsType.ACCURACY])
     dl = ff.DataLoader.synthetic(model, inp, num_samples=batch_size)
     model.init_layers()
+    dl.next_batch(model)
+    return model
 
+
+def _fwd_flops_per_sample(model):
+    return sum(op.flops_per_sample() for op in model.ops)
+
+
+def run_one(name, batch_size=256, compute_dtype="bfloat16", steps=24,
+            fused=False):
+    """(samples/s/chip, achieved TFLOPS, MFU) for one model's train loop."""
+    import jax
+
+    model = _build(name, batch_size, compute_dtype, fused=fused)
     # Compile + warmup: two steps — the first step's outputs carry
     # committed shardings the initial arrays lacked, so step two triggers
     # one more (final) compilation before the shapes/shardings fixpoint.
-    dl.next_batch(model)
     model.train_iteration()
     model.train_iteration()
     model.sync()
-
     t0 = time.perf_counter()
-    steps = epochs * iters_per_epoch
     for _ in range(steps):
         model.train_iteration()
     model.sync()
     dt = time.perf_counter() - t0
-    throughput = steps * batch_size / dt
-    return throughput, n_dev
+    n_dev = max(1, len(jax.devices()))
+    sps = steps * batch_size / dt / n_dev
+    train_flops = 3.0 * _fwd_flops_per_sample(model)  # fwd + dgrad + wgrad
+    tflops = sps * train_flops / 1e12
+    return sps, tflops, tflops * 1e12 / PEAK_FLOPS
 
 
 def main():
@@ -65,16 +86,43 @@ def main():
     # A wedged TPU tunnel hangs backend init forever; without this the
     # driver would get NO json line at all.
     signal.signal(signal.SIGALRM, _timeout)
-    signal.alarm(1200)
+    signal.alarm(2400)
+    extra = {}
     try:
-        throughput, n_dev = run()
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/flexflow_tpu_jax_cache")
+        sps_a, tf_a, mfu_a = run_one("alexnet", batch_size=256)
+        extra["alexnet"] = {"samples_per_sec_per_chip": round(sps_a, 2),
+                            "achieved_tflops": round(tf_a, 1),
+                            "mfu": round(mfu_a, 3)}
+        try:
+            sps_i, tf_i, mfu_i = run_one("inception_v3", batch_size=128,
+                                         steps=12)
+            extra["inception_v3"] = {
+                "samples_per_sec_per_chip": round(sps_i, 2),
+                "achieved_tflops": round(tf_i, 1),
+                "mfu": round(mfu_i, 3)}
+        except Exception as e:
+            extra["inception_v3"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            # fused Pallas optimizer kernels on the real chip (single
+            # device): proves they compile+run outside interpret mode
+            sps_f, _, _ = run_one("alexnet", batch_size=256, steps=8,
+                                  fused=True)
+            extra["fused_optimizer"] = {
+                "ok": True, "samples_per_sec_per_chip": round(sps_f, 2)}
+        except Exception as e:
+            extra["fused_optimizer"] = {
+                "ok": False, "error": f"{type(e).__name__}: {e}"}
         signal.alarm(0)
-        per_chip = throughput / max(1, n_dev)
         print(json.dumps({
             "metric": "alexnet_train_samples_per_sec_per_chip",
-            "value": round(per_chip, 2),
+            "value": round(sps_a, 2),
             "unit": "samples/s/chip",
-            "vs_baseline": round(per_chip / PER_CHIP_BASELINE, 3),
+            "vs_baseline": round(sps_a / PER_CHIP_BASELINE, 3),
+            "extra": extra,
         }))
     except Exception as e:  # never leave the driver without a line
         print(json.dumps({
@@ -82,6 +130,7 @@ def main():
             "value": 0.0,
             "unit": "samples/s/chip",
             "vs_baseline": 0.0,
+            "extra": extra,
             "error": f"{type(e).__name__}: {e}",
         }))
         raise

@@ -1,0 +1,40 @@
+"""Functional MNIST MLP
+(reference: examples/python/keras/func_mnist_mlp.py)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import Dense, Input, Model
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task(num_samples=4096, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    inp = Input(shape=(784,))
+    h = Dense(512, activation="relu", name="dense1")(inp)
+    h = Dense(512, activation="relu", name="dense2")(h)
+    out = Dense(10, activation="softmax", name="dense3")(h)
+    model = Model(inputs=[inp], outputs=out,
+                  config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

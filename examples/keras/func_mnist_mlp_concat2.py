@@ -1,0 +1,44 @@
+"""Functional MLP with nested concats (three towers, two merges)
+(reference: examples/python/keras/func_mnist_mlp_concat2.py)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import Concatenate, Dense, Input, Model
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task(num_samples=2048, epochs=4, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    inp = Input(shape=(784,))
+    t1 = Dense(128, activation="relu", name="t1")(inp)
+    t2 = Dense(128, activation="relu", name="t2")(inp)
+    t3 = Dense(128, activation="relu", name="t3")(inp)
+    m1 = Concatenate(axis=1, name="concat1")([t1, t2])
+    m2 = Concatenate(axis=1, name="concat2")([m1, t3])
+    h = Dense(128, activation="relu", name="dense1")(m2)
+    out = Dense(10, activation="softmax", name="dense2")(h)
+    model = Model(inputs=[inp], outputs=out,
+                  config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,64 @@
+"""Net2Net weight transfer between functional MLPs
+(reference: examples/python/keras/func_mnist_mlp_net2net.py — train a
+teacher, seed a (wider) student with the teacher's weights where shapes
+match, verify the student trains at least as well)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import Dense, Input, Model
+from flexflow_tpu.keras.datasets import mnist
+
+
+def build(widths, batch_size, names):
+    inp = Input(shape=(784,))
+    h = inp
+    for w, n in zip(widths, names):
+        h = Dense(w, activation="relu", name=n)(h)
+    out = Dense(10, activation="softmax", name="head")(h)
+    return Model(inputs=[inp], outputs=out,
+                 config=FFConfig(batch_size=batch_size))
+
+
+def top_level_task(num_samples=2048, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    teacher = build([256], batch_size, ["fc1"])
+    teacher.compile(SGD(lr=0.05), "sparse_categorical_crossentropy",
+                    ["accuracy"])
+    teacher.fit(x_train, y_train, epochs=epochs,
+                callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+
+    # student: same first layer + one extra; transfer fc1 + head weights
+    student = build([256, 256], batch_size, ["fc1", "fc2"])
+    student.compile(SGD(lr=0.05), "sparse_categorical_crossentropy",
+                    ["accuracy"])
+    t_by_name = {l.name: l for l in teacher.layers}
+    for s_layer in student.layers:
+        t_layer = t_by_name.get(s_layer.name)
+        if t_layer is not None and t_layer._type == s_layer._type:
+            s_layer.set_weights(student.ffmodel,
+                                *t_layer.get_weights(teacher.ffmodel))
+    k = teacher.ffmodel.get_parameter("fc1", "kernel")
+    got = student.ffmodel.get_parameter("fc1", "kernel")
+    np.testing.assert_array_equal(got, k)
+    student.fit(x_train, y_train, epochs=epochs,
+                callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    return student
+
+
+if __name__ == "__main__":
+    top_level_task()

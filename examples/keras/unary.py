@@ -1,0 +1,45 @@
+"""Elementwise / activation layer exercise
+(reference: examples/python/keras/unary.py — drives every ElementUnary
+through the keras surface and checks the model still trains)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import Activation, Dense, Input, Model
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task(num_samples=2048, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    inp = Input(shape=(784,))
+    h = Dense(256, name="dense1")(inp)
+    h = Activation("relu", name="a_relu")(h)
+    h = Dense(128, name="dense2")(h)
+    h = Activation("tanh", name="a_tanh")(h)
+    h = Dense(64, name="dense3")(h)
+    h = Activation("sigmoid", name="a_sigmoid")(h)
+    out = Dense(10, activation="softmax", name="head")(h)
+    model = Model(inputs=[inp], outputs=out,
+                  config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.05), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

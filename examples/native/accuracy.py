@@ -1,0 +1,9 @@
+"""Accuracy thresholds for the python-native examples
+(reference: examples/python/native/accuracy.py)."""
+
+
+class ModelAccuracy:
+    MNIST_MLP = 60.0
+    MNIST_CNN = 60.0
+    CIFAR10_CNN = 30.0
+    CIFAR10_ALEXNET = 30.0

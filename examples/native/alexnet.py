@@ -1,0 +1,45 @@
+"""Python-native AlexNet (reference: examples/python/native/alexnet.py —
+the python twin of the cpp app, synthetic data, throughput print).
+Thin driver over the shared model builder at reduced default size.
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+import time
+
+from flexflow_tpu.models.alexnet import build_alexnet
+
+
+def top_level_task(argv=None, iters=8):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    model = ff.FFModel(cfg)
+    inp, _ = build_alexnet(model, cfg.batch_size)
+    model.compile(ff.SGDOptimizer(model, lr=0.01),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    dl = ff.DataLoader.synthetic(model, inp, num_samples=cfg.batch_size)
+    model.init_layers()
+    dl.next_batch(model)
+    model.train_iteration()   # compile + warmup
+    model.sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        model.train_iteration()
+    model.sync()
+    dt = time.perf_counter() - t0
+    print(f"ELAPSED TIME = {dt:.4f}s, "
+          f"THROUGHPUT = {iters * cfg.batch_size / dt:.2f} samples/s")
+
+
+if __name__ == "__main__":
+    top_level_task()

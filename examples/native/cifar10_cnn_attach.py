@@ -1,0 +1,46 @@
+"""CIFAR-10 CNN with host-attached numpy data
+(reference: examples/python/native/cifar10_cnn_attach.py — the
+attach_raw_ptr zero-copy path; here the DataLoader aliases the caller's
+arrays, asserted by pointer identity).
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import cifar10
+from examples.native.accuracy import ModelAccuracy
+from examples.native.cifar10_cnn import build_cnn, train
+
+
+def top_level_task(argv=None, num_samples=1024, epochs=None):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    (x_train, y_train), _ = cifar10.load_data()
+    x = np.ascontiguousarray(x_train[:num_samples].astype(np.float32) / 255.0)
+    y = np.ascontiguousarray(y_train[:num_samples].astype(np.int32).reshape(-1, 1))
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 3, 32, 32), name="input")
+    build_cnn(model, inp)
+    model.compile(ff.SGDOptimizer(model, lr=0.02),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    dl = ff.DataLoader(model, {inp: x}, y)
+    # zero-copy contract: labels alias the caller's buffer (images are
+    # layout-converted NCHW->NHWC once on attach, like the reference's
+    # one-time load into ZC memory)
+    assert np.shares_memory(dl.labels, y)
+    acc = train(model, dl, cfg, epochs)
+    assert acc >= ModelAccuracy.CIFAR10_CNN, acc
+    return acc
+
+
+if __name__ == "__main__":
+    top_level_task()

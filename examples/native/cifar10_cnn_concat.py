@@ -1,0 +1,52 @@
+"""CIFAR-10 CNN with two conv towers concatenated
+(reference: examples/python/native/cifar10_cnn_concat.py).
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import cifar10
+from examples.native.accuracy import ModelAccuracy
+from examples.native.cifar10_cnn import train
+
+
+def top_level_task(argv=None, num_samples=1024, epochs=None):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    (x_train, y_train), _ = cifar10.load_data()
+    x = x_train[:num_samples].astype(np.float32) / 255.0
+    y = y_train[:num_samples].astype(np.int32).reshape(-1, 1)
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 3, 32, 32), name="input")
+    t1 = model.conv2d(inp, 32, 3, 3, 1, 1, 1, 1,
+                      activation=ff.ActiMode.RELU, name="tower1_conv")
+    t2 = model.conv2d(inp, 32, 5, 5, 1, 1, 2, 2,
+                      activation=ff.ActiMode.RELU, name="tower2_conv")
+    t = model.concat([t1, t2], axis=1, name="concat")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1,
+                     activation=ff.ActiMode.RELU, name="conv3")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool2")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, 128, activation=ff.ActiMode.RELU, name="dense1")
+    t = model.dense(t, 10, name="dense2")
+    model.softmax(t, name="softmax")
+    model.compile(ff.SGDOptimizer(model, lr=0.02),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    dl = ff.DataLoader(model, {inp: x}, y)
+    acc = train(model, dl, cfg, epochs)
+    assert acc >= ModelAccuracy.CIFAR10_CNN, acc
+    return acc
+
+
+if __name__ == "__main__":
+    top_level_task()

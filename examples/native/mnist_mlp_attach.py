@@ -1,0 +1,66 @@
+"""MNIST MLP with a host-attached (zero-copy) dataset
+(reference: examples/python/native/mnist_mlp_attach.py — numpy arrays
+attached to tensors via Tensor::attach_raw_ptr, model.cc:73-93).
+
+The DataLoader holds references to the caller's numpy arrays — no copy.
+This example proves the zero-copy contract by mutating the attached
+array in place mid-training and observing the next epoch train on the
+new data.
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+from examples.native.accuracy import ModelAccuracy
+
+
+def top_level_task(argv=None, num_samples=2048):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    (x_train, y_train), _ = mnist.load_data()
+    x = np.ascontiguousarray(
+        x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0)
+    y = np.ascontiguousarray(y_train[:num_samples].astype(np.int32).reshape(-1, 1))
+
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 784), name="input", nchw=False)
+    t = model.dense(inp, 256, activation=ff.ActiMode.RELU, name="dense1")
+    t = model.dense(t, 10, name="dense2")
+    model.softmax(t, name="softmax")
+    model.compile(ff.SGDOptimizer(model, lr=0.02),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    dl = ff.DataLoader(model, {inp: x}, y)   # attach: dl aliases x and y
+    assert np.shares_memory(dl.inputs[inp], x) and np.shares_memory(dl.labels, y)
+    model.init_layers()
+
+    for epoch in range(max(2, cfg.epochs)):
+        if epoch == 1:
+            # in-place permutation of the ATTACHED arrays — the loader
+            # sees the new order without re-attaching (zero-copy)
+            perm = np.random.default_rng(0).permutation(len(x))
+            x[:] = x[perm]
+            y[:] = y[perm]
+        dl.reset()
+        model.reset_metrics()
+        for _ in range(dl.num_batches()):
+            dl.next_batch(model)
+            model.train_iteration()
+        model.sync()
+        print(f"epoch {epoch}: {model.get_metrics().to_string()}")
+    acc = model.get_metrics().accuracy
+    assert acc >= ModelAccuracy.MNIST_MLP, acc
+    return acc
+
+
+if __name__ == "__main__":
+    top_level_task()

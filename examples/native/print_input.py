@@ -1,0 +1,40 @@
+"""Input round-trip check
+(reference: examples/python/native/print_input.py — prints the staged
+input batch to verify the host->device feed)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task(argv=None):
+    cfg = ff.FFConfig(batch_size=4)
+    cfg.parse_args(argv)
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 8), name="input", nchw=False)
+    t = model.dense(inp, 4, name="fc")
+    model.softmax(t, name="softmax")
+    model.compile(ff.SGDOptimizer(model, lr=0.01),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    model.init_layers()
+    x = np.arange(cfg.batch_size * 8, dtype=np.float32).reshape(cfg.batch_size, 8)
+    y = np.zeros((cfg.batch_size, 1), dtype=np.int32)
+    model.set_batch({inp: x}, y)
+    staged = np.asarray(model._batch[f"in_{inp.guid}"])
+    print("staged input:")
+    print(staged)
+    np.testing.assert_array_equal(staged, x)
+    return True
+
+
+if __name__ == "__main__":
+    top_level_task()

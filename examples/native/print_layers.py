@@ -1,0 +1,44 @@
+"""Layer/strategy introspection
+(reference: examples/python/native/print_layers.py — walks the op list
+printing layer metadata and weights)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task(argv=None):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 3, 32, 32), name="input")
+    t = model.conv2d(inp, 16, 3, 3, 1, 1, 1, 1, name="conv1")
+    t = model.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, 10, name="fc")
+    model.softmax(t, name="softmax")
+    model.compile(ff.SGDOptimizer(model, lr=0.01),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    model.init_layers()
+    for i, op in enumerate(model.ops):
+        pc = model.get_strategies()[op.name]
+        print(f"layer[{i}] {op!r} pc={list(pc.dims)}")
+        for w in op.weights:
+            arr = model.get_parameter(op.name, w.name)
+            print(f"   weight {w.name}: shape {arr.shape} "
+                  f"|mean| {np.abs(arr).mean():.4f}")
+    assert len(model.ops) == 5
+    return len(model.ops)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,39 @@
+"""Weight get/set (tensor attach) round-trip
+(reference: examples/python/native/tensor_attach.py — numpy attach to a
+parameter region and read-back)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task(argv=None):
+    cfg = ff.FFConfig(batch_size=4)
+    cfg.parse_args(argv)
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 8), name="input", nchw=False)
+    t = model.dense(inp, 6, name="fc1")
+    t = model.dense(t, 4, name="fc2")
+    model.softmax(t, name="softmax")
+    model.compile(ff.SGDOptimizer(model, lr=0.01),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    model.init_layers()
+    w = np.arange(8 * 6, dtype=np.float32).reshape(8, 6)
+    model.set_parameter("fc1", "kernel", w)
+    back = model.get_parameter("fc1", "kernel")
+    np.testing.assert_array_equal(back, w)
+    print("tensor_attach: set/get round-trip OK", back.shape)
+    return True
+
+
+if __name__ == "__main__":
+    top_level_task()

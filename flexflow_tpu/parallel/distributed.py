@@ -54,15 +54,38 @@ def initialize(coordinator_address: Optional[str] = None,
         num_processes = int(os.environ["NUM_PROCESSES"])
     if process_id is None and os.environ.get("PROCESS_ID"):
         process_id = int(os.environ["PROCESS_ID"])
-    on_tpu = jax.default_backend() == "tpu"
-    if coordinator_address is None and not on_tpu:
-        return  # single-process CPU/GPU run, nothing to do
     if num_processes is not None and num_processes <= 1:
         return
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id,
-                               local_device_ids=local_device_ids)
+    # IMPORTANT: nothing here may touch the XLA backend (jax.devices,
+    # jax.default_backend, ...) — jax.distributed.initialize must run
+    # before backend init or it refuses outright.
+    if coordinator_address is None and num_processes is None:
+        # If the XLA backend is ALREADY up we may query it without side
+        # effects: a non-TPU backend with no coordinator info is a plain
+        # single-process run — return rather than let the bare initialize
+        # raise "must be called before any JAX calls" for a case that
+        # needs no coordination at all.
+        try:
+            from jax._src import xla_bridge
+            backend_up = xla_bridge.backends_are_initialized()
+        except Exception:
+            backend_up = False
+        if backend_up and jax.default_backend() != "tpu":
+            return
+        # TPU pods autodetect everything from the metadata server; on any
+        # other backend the bare call raises ValueError immediately →
+        # single-process.  RuntimeError ("must be called before any JAX
+        # calls") propagates: on a pod, swallowing it would silently turn
+        # N hosts into N independent single-process runs.
+        try:
+            jax.distributed.initialize()
+        except ValueError:
+            return
+    else:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id,
+                                   local_device_ids=local_device_ids)
     _initialized = True
 
 

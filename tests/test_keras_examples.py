@@ -80,3 +80,60 @@ def test_candle_uno_builds_and_trains():
         model.reset_metrics()
     model.sync()
     assert losses[-1] < losses[0], f"MSE did not decrease: {losses[0]} -> {losses[-1]}"
+
+
+def test_func_mnist_mlp():
+    from examples.keras.func_mnist_mlp import top_level_task
+
+    top_level_task(num_samples=512, epochs=2)
+
+
+def test_func_mnist_cnn():
+    from examples.keras.func_mnist_cnn import top_level_task
+
+    top_level_task(num_samples=512, epochs=2)
+
+
+def test_func_mnist_cnn_concat():
+    from examples.keras.func_mnist_cnn_concat import top_level_task
+
+    top_level_task(num_samples=512, epochs=2)
+
+
+def test_func_mnist_mlp_concat2():
+    from examples.keras.func_mnist_mlp_concat2 import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_func_mnist_mlp_net2net():
+    from examples.keras.func_mnist_mlp_net2net import top_level_task
+
+    top_level_task(num_samples=512, epochs=2)
+
+
+@pytest.mark.slow
+def test_func_cifar10_cnn():
+    from examples.keras.func_cifar10_cnn import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+@pytest.mark.slow
+def test_func_cifar10_cnn_concat():
+    from examples.keras.func_cifar10_cnn_concat import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+@pytest.mark.slow
+def test_func_cifar10_alexnet():
+    from examples.keras.func_cifar10_alexnet import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_unary_activations():
+    from examples.keras.unary import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)

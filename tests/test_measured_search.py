@@ -1,0 +1,163 @@
+"""The search consumes REAL measured cost entries (VERDICT r2 #1).
+
+Reference semantics: the MCMC search costs every candidate with measured
+kernel times cached by (op, config) hash (simulator.cc:235-273).  Here
+the measurements are taken up-front (tools/calibrate.py on the chip) and
+shipped in a durable cache; these tests pin the contract that a search
+run actually READS those entries — and that provenance rules hold
+(only real measurements persist; platform tags filter)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.simulator.cost_model import CostModel
+from flexflow_tpu.simulator.machine import TPUMachineModel
+from flexflow_tpu.simulator.search import mcmc_search
+from flexflow_tpu.simulator.simulator import Simulator
+
+
+def _model(batch=64):
+    cfg = ff.FFConfig(batch_size=batch)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((batch, 16), nchw=False)
+    t = m.dense(inp, 32, activation="relu", name="fc1")
+    t = m.dense(t, 10, name="fc2")
+    m.softmax(t, name="sm")
+    return m
+
+
+def _fill_cache(path, model, mm, value=1e-3):
+    """Fabricate a 'measured' cache covering every candidate sub-shape."""
+    from flexflow_tpu.simulator.native_search import enumerate_candidates
+
+    probe = CostModel(mm, measure=False, cache_path=None,
+                      measured_cache_path="/nonexistent")
+    entries = {}
+    for op in model.ops:
+        for pc in enumerate_candidates(op, mm.num_devices):
+            pc = op.legalize_pc(pc)
+            for which in ("forward", "backward"):
+                key = probe._key(op, pc, which)
+                entries[key] = {"t": value, "measured": True,
+                                "platform": "tpu"}
+    with open(path, "w") as f:
+        json.dump(entries, f)
+    return len(entries)
+
+
+def test_search_consumes_measured_entries(tmp_path, devices):
+    model = _model()
+    mm = TPUMachineModel(num_devices=8)
+    cache = str(tmp_path / "measured.json")
+    n = _fill_cache(cache, model, mm, value=1e-3)
+    assert n > 0
+
+    cost = CostModel(mm, measure=False, cache_path=None,
+                     measured_cache_path=cache)
+    sim = Simulator(mm, cost)
+    dp = {op.name: ff.ParallelConfig.data_parallel(op.output.num_dims, 8)
+          .with_device_ids(tuple(range(8)))
+          for op in model.ops}
+    rt = sim.simulate_runtime(model, dp)
+    assert cost.stats["measured_hits"] > 0
+    assert cost.stats["analytic"] == 0  # full coverage: nothing analytic
+    # compute portion = 3 ops x (1ms fwd after deps...) — at minimum the
+    # critical path carries the fabricated values, not roofline guesses
+    assert rt >= 2e-3  # fwd+bwd of at least one op chain at 1 ms each
+
+
+def test_measured_entries_change_search_outcome(tmp_path, devices):
+    """Poisoning the measured cache against batch splits steers the
+    search away from them — proof the entries drive the objective."""
+    from flexflow_tpu.simulator.native_search import enumerate_candidates
+
+    model = _model()
+    mm = TPUMachineModel(num_devices=8)
+    cache = str(tmp_path / "measured.json")
+    probe = CostModel(mm, measure=False, cache_path=None,
+                      measured_cache_path="/nonexistent")
+    entries = {}
+    for op in model.ops:
+        for pc in enumerate_candidates(op, mm.num_devices):
+            pc = op.legalize_pc(pc)
+            # any sample-dim split is 'measured' as catastrophically slow
+            bad = pc.dims[0] > 1
+            for which in ("forward", "backward"):
+                entries[probe._key(op, pc, which)] = {
+                    "t": 1.0 if bad else 1e-6,
+                    "measured": True, "platform": "tpu"}
+    with open(cache, "w") as f:
+        json.dump(entries, f)
+
+    import flexflow_tpu.simulator.search as search_mod
+
+    orig = CostModel
+
+    def patched(mm_, **kw):
+        kw["measured_cache_path"] = cache
+        kw["cache_path"] = None
+        return orig(mm_, **kw)
+
+    search_mod.CostModel, saved = patched, search_mod.CostModel
+    try:
+        best = mcmc_search(model, budget=300, machine_model=mm, seed=1,
+                           verbose=False)
+    finally:
+        search_mod.CostModel = saved
+    assert all(pc.dims[0] == 1 for pc in best.values()), best
+
+
+def test_cpu_measurements_never_masquerade_as_tpu(tmp_path, devices):
+    """Platform-tagged entries: a cpu-tagged measurement is invisible to
+    a TPU-targeting cost model (the provenance rule calibrate relies on)."""
+    model = _model()
+    mm = TPUMachineModel(num_devices=8)
+    cache = str(tmp_path / "measured.json")
+    probe = CostModel(mm, measure=False, cache_path=None,
+                      measured_cache_path="/nonexistent")
+    op = model.ops[0]
+    pc = ff.ParallelConfig.data_parallel(op.output.num_dims, 8)
+    key = probe._key(op, op.legalize_pc(pc), "forward")
+    with open(cache, "w") as f:
+        json.dump({key: {"t": 123.0, "measured": True,
+                         "platform": "cpu"}}, f)
+    tpu_cost = CostModel(mm, measure=False, cache_path=None,
+                         measured_cache_path=cache, target_platform="tpu")
+    assert key not in tpu_cost._measured
+    cpu_cost = CostModel(mm, measure=False, cache_path=None,
+                         measured_cache_path=cache, target_platform="cpu")
+    assert cpu_cost._measured[key] == 123.0
+
+
+def test_only_measured_entries_persist(tmp_path, devices):
+    """Analytic fallbacks never reach the durable cache."""
+    model = _model()
+    mm = TPUMachineModel(num_devices=8)
+    local = str(tmp_path / "local.json")
+    cost = CostModel(mm, measure=False, cache_path=local,
+                     measured_cache_path="/nonexistent")
+    op = model.ops[0]
+    pc = op.legalize_pc(
+        ff.ParallelConfig.data_parallel(op.output.num_dims, 8))
+    t = cost.op_time(op, pc, "forward")
+    assert t > 0 and cost.stats["analytic"] == 1
+    assert not os.path.exists(local)  # nothing persisted
+
+
+def test_soap_report_generator(tmp_path, devices):
+    """End-to-end report: search runs, report + strategy file written."""
+    from flexflow_tpu.tools.soap_report import main
+
+    out = str(tmp_path / "REPORT.md")
+    pb = str(tmp_path / "s.pb")
+    res = main(["alexnet", "--devices", "8", "--batch-size", "128",
+                "--budget", "200", "--export", pb, "--out", out,
+                "--measured-single-chip-ms", "10.0"])
+    assert os.path.exists(out) and os.path.exists(pb)
+    assert res["speedup"] >= 1.0
+    text = open(out).read()
+    assert "SOAP searched" in text and "agreement" in text.lower()

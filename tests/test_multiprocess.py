@@ -1,0 +1,126 @@
+"""REAL multi-process execution: 2 controllers, one logical mesh.
+
+The reference exercises multi-node only on physical clusters (Summit
+jsrun scripts — SURVEY §4.5); here the multi-controller runtime is
+spawned in CI: two OS processes × 4 virtual CPU devices each form one
+8-device dcn×ici mesh via ``jax.distributed.initialize`` (the
+GASNet-startup analogue), each process feeds its host-local half of the
+global batch (``host_local_batch`` ≈ DataParallelShardingFunctor,
+model.cc:1361-1370), and training numerics must equal a single-process
+run on the same global batch.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+sys.path.insert(0, {root!r})
+import flexflow_tpu as ff
+from flexflow_tpu.parallel import distributed as dist
+
+dist.initialize()  # reads COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID
+pid = jax.process_index()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 4
+assert jax.device_count() == 8
+
+cfg = ff.FFConfig(batch_size=16, workers_per_node=4, num_nodes=2)
+m = ff.FFModel(cfg)
+inp = m.create_tensor((16, 8), nchw=False, name='input')
+t = m.dense(inp, 16, activation='relu', name='fc1')
+t = m.dense(t, 4, name='fc2')
+m.softmax(t, name='sm')
+m.compile(ff.SGDOptimizer(lr=0.5), 'sparse_categorical_crossentropy',
+          ['accuracy'])
+assert m.machine.axis_names[0] == 'dcn', m.machine.axis_names
+m.init_layers(seed=5)
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((16, 8), dtype=np.float32)   # the GLOBAL batch
+Y = np.argmax(X[:, :4], 1).astype(np.int32)[:, None]
+half = 8
+lo, hi = pid * half, (pid + 1) * half
+for _ in range(5):
+    m.set_batch({{inp: X[lo:hi]}}, Y[lo:hi])   # host-LOCAL shard
+    m.train_iteration()
+m.sync()
+k1 = m.get_parameter('fc1', 'kernel')
+k2 = m.get_parameter('fc2', 'kernel')
+print('FPRINT', pid, float(np.sum(np.abs(k1))), float(np.sum(k1 * k1)),
+      float(np.sum(np.abs(k2))), flush=True)
+dist.shutdown()
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_training_matches_single_process(devices):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["NUM_PROCESSES"] = "2"
+        env["PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD.format(root=_ROOT)],
+            env=env, cwd=_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    fprints = {}
+    try:
+        for pid, p in enumerate(procs):
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"proc {pid} failed:\n{err[-3000:]}"
+            line = [l for l in out.splitlines() if l.startswith("FPRINT")][0]
+            fprints[pid] = tuple(float(v) for v in line.split()[2:])
+    finally:
+        for p in procs:  # a failed/hung sibling must not outlive the test
+            if p.poll() is None:
+                p.kill()
+
+    # both controllers hold identical (replicated) trained weights
+    np.testing.assert_allclose(fprints[0], fprints[1], rtol=1e-5)
+
+    # and they match the single-process run on the same global batch
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=16, workers_per_node=8)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 8), nchw=False, name="input")
+    t = m.dense(inp, 16, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.5), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=5)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((16, 8), dtype=np.float32)
+    Y = np.argmax(X[:, :4], 1).astype(np.int32)[:, None]
+    for _ in range(5):
+        m.set_batch({inp: X}, Y)
+        m.train_iteration()
+    m.sync()
+    k1 = m.get_parameter("fc1", "kernel")
+    k2 = m.get_parameter("fc2", "kernel")
+    ref = (float(np.sum(np.abs(k1))), float(np.sum(k1 * k1)),
+           float(np.sum(np.abs(k2))))
+    np.testing.assert_allclose(fprints[0], ref, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,72 @@
+"""Python-native example scripts as integration tests (reference:
+python/test.sh runs every native example; SURVEY.md §4.1 — examples ARE
+the reference's test suite)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+
+def test_mnist_mlp():
+    from examples.native.mnist_mlp import top_level_task
+
+    assert top_level_task(["-e", "2", "-b", "64"], num_samples=512) >= 60.0
+
+
+def test_mnist_mlp_attach():
+    from examples.native.mnist_mlp_attach import top_level_task
+
+    assert top_level_task(["-e", "2", "-b", "64"], num_samples=512) >= 60.0
+
+
+def test_mnist_cnn():
+    from examples.native.mnist_cnn import top_level_task
+
+    assert top_level_task(["-e", "2", "-b", "64"], num_samples=512) >= 60.0
+
+
+@pytest.mark.slow
+def test_cifar10_cnn():
+    from examples.native.cifar10_cnn import top_level_task
+
+    assert top_level_task(["-b", "64"], num_samples=512, epochs=4) >= 30.0
+
+
+@pytest.mark.slow
+def test_cifar10_cnn_attach():
+    from examples.native.cifar10_cnn_attach import top_level_task
+
+    assert top_level_task(["-b", "64"], num_samples=512, epochs=4) >= 30.0
+
+
+@pytest.mark.slow
+def test_cifar10_cnn_concat():
+    from examples.native.cifar10_cnn_concat import top_level_task
+
+    assert top_level_task(["-b", "64"], num_samples=512, epochs=4) >= 30.0
+
+
+def test_alexnet_torch_one_step_parity():
+    from examples.native.alexnet_torch import top_level_task
+
+    top_level_task([])
+
+
+def test_print_layers():
+    from examples.native.print_layers import top_level_task
+
+    assert top_level_task(["-b", "8"]) == 5
+
+
+def test_print_input():
+    from examples.native.print_input import top_level_task
+
+    assert top_level_task([])
+
+
+def test_tensor_attach():
+    from examples.native.tensor_attach import top_level_task
+
+    assert top_level_task([])
