@@ -651,13 +651,14 @@ class FFModel:
         # overrides the pipelined ops' configs with no-split placeholders.
         self._plan_pipeline()
 
-        # Fused Pallas optimizer kernels: single-device only (the Pallas
-        # custom call is not GSPMD-partitionable across a mesh).
-        # Unconditional assignment so an optimizer reused across
-        # compiles never carries a stale True onto a sharded machine.
+        # Fused Pallas optimizer kernels: on a multi-device machine each
+        # parameter's update runs inside a per-leaf shard_map with its
+        # own PartitionSpec (optimizers.Optimizer._shardwise) —
+        # init_layers installs the mesh + specs.  Unconditional
+        # assignment so an optimizer reused across compiles never
+        # carries a stale flag.
         if optimizer is not None:
-            optimizer.fused = bool(cfg.fused_optimizer
-                                   and self.machine.num_devices == 1)
+            optimizer.fused = bool(cfg.fused_optimizer)
 
         # Export AFTER resolution so imported/searched configs are what get
         # written (reference exports from FFConfig::strategies the same way).
@@ -832,6 +833,16 @@ class FFModel:
                     st, self.machine.replicated())
         # Optimizer state mirrors the params pytree and inherits each
         # param's sharding (momentum/moment buffers live with their shard).
+        if self.optimizer is not None:
+            specs = {opn: {wn: sh.spec for wn, sh in ws.items()}
+                     for opn, ws in shardings.items()}
+            multi = self.machine.num_devices > 1
+            # Host-offloaded leaves take the plain update (their streaming
+            # device_put pairs don't model Pallas aliasing); every other
+            # leaf keeps the fused path.
+            self.optimizer.set_mesh(self.machine.mesh if multi else None,
+                                    specs,
+                                    nonfused_paths=set(self._offload))
         self._opt_state = (self._init_opt_state()
                            if self.optimizer is not None else None)
         self._step_count = 0

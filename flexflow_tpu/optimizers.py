@@ -41,6 +41,60 @@ HParams = Dict[str, Any]
 class Optimizer:
     """Base optimizer. State is a pytree mirroring the params pytree."""
 
+    # Fused-kernel routing (kernels/fused_optimizer.py): the Pallas call
+    # is not GSPMD-partitionable, so on a multi-device machine each
+    # parameter's update runs inside a per-leaf shard_map with the
+    # param's own PartitionSpec — every chip fuses-updates exactly its
+    # local shard (the moral twin of the reference running
+    # optimizer_kernel.cu on the parameter's home GPU,
+    # optimizer.cc:74-101).  FFModel.init_layers installs mesh + specs.
+    mesh = None
+    param_specs = None
+    nonfused_paths: frozenset = frozenset()
+
+    def set_mesh(self, mesh, param_specs, nonfused_paths=()) -> None:
+        """``nonfused_paths``: (op_name, weight_name) leaves that must
+        take the plain jnp update (host-offloaded weights stream through
+        device_put pairs the Pallas aliasing path doesn't model)."""
+        self.mesh = mesh
+        self.param_specs = param_specs
+        self.nonfused_paths = frozenset(nonfused_paths)
+
+    def _leaf_fused(self, path) -> bool:
+        try:
+            key = tuple(p.key for p in path)
+        except AttributeError:
+            return True
+        return key not in self.nonfused_paths
+
+    def _spec_for_path(self, path):
+        """PartitionSpec for a params-tree key path (PartitionSpec is a
+        tuple subclass, hence a pytree NODE — specs can't ride tree.map
+        and are looked up by path instead)."""
+        node = self.param_specs
+        if node is None:
+            return None
+        try:
+            for p in path:
+                node = node[p.key]
+        except (KeyError, TypeError, AttributeError):
+            return None
+        return node
+
+    def _shardwise(self, fn, spec, n_in, n_out):
+        """Wrap a per-parameter fused update ``fn(hp, *operands)`` to run
+        per-shard when the machine is a real mesh; identity wrapper on a
+        single device.  ``hp`` is a replicated scalar vector."""
+        if self.mesh is None or self.mesh.devices.size <= 1 or spec is None:
+            return fn
+        from jax.sharding import PartitionSpec
+
+        scalar = PartitionSpec()
+        in_specs = tuple([scalar] + [spec] * n_in)
+        out_specs = tuple([spec] * n_out) if n_out > 1 else spec
+        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
     def init_state(self, params: Params) -> OptState:
         raise NotImplementedError
 
@@ -71,9 +125,8 @@ class SGDOptimizer(Optimizer):
         self.weight_decay = float(weight_decay)
         # Set by FFModel.compile from FFConfig.fused_optimizer: route the
         # update through the Pallas kernels (kernels/fused_optimizer.py,
-        # the analogue of the reference's optimizer_kernel.cu).  Pallas
-        # calls are not GSPMD-partitionable, so compile only enables this
-        # on single-device machines.
+        # the analogue of the reference's optimizer_kernel.cu).  On a
+        # mesh each leaf updates per-shard via Optimizer._shardwise.
         self.fused = False
 
     def init_state(self, params):
@@ -89,22 +142,37 @@ class SGDOptimizer(Optimizer):
         wd, mom = self.weight_decay, self.momentum
 
         if self.fused:
+            from jax.tree_util import tree_map_with_path
+
             from .kernels.fused_optimizer import fused_sgd_update
 
             if mom > 0.0:
-                def fupd(w, g, v):
-                    return fused_sgd_update(w, g, v, lr, wd, mom,
-                                            self.nesterov)
+                def fupd(path, w, g, v):
+                    if not self._leaf_fused(path):
+                        gt = g + wd * w
+                        vn = v * mom + gt
+                        step = gt + mom * vn if self.nesterov else vn
+                        return w - lr * step.astype(w.dtype), vn
+                    def body(hp, w, g, v):
+                        return fused_sgd_update(w, g, v, hp, wd, mom,
+                                                self.nesterov)
+                    return self._shardwise(body, self._spec_for_path(path),
+                                           3, 2)(lr, w, g, v)
 
-                out = jax.tree.map(fupd, params, grads, state["v"])
+                out = tree_map_with_path(fupd, params, grads, state["v"])
                 new_params, new_v = _unzip(out, 2)
                 return new_params, {"v": new_v}
 
-            def fupd_plain(w, g):
-                # momentum buffer unused: the kernel passes it through
-                return fused_sgd_update(w, g, g, lr, wd, 0.0, False)[0]
+            def fupd_plain(path, w, g):
+                if not self._leaf_fused(path):
+                    return w - lr * (g + wd * w).astype(w.dtype)
+                def body(hp, w, g):
+                    # momentum buffer unused: the kernel passes it through
+                    return fused_sgd_update(w, g, g, hp, wd, 0.0, False)[0]
+                return self._shardwise(body, self._spec_for_path(path),
+                                       2, 1)(lr, w, g)
 
-            return jax.tree.map(fupd_plain, params, grads), {}
+            return tree_map_with_path(fupd_plain, params, grads), {}
 
         if mom > 0.0:
             def upd(w, g, v):
@@ -157,12 +225,24 @@ class AdamOptimizer(Optimizer):
         wd, b1, b2, eps = self.weight_decay, self.beta1, self.beta2, self.epsilon
 
         if self.fused:
+            from jax.tree_util import tree_map_with_path
+
             from .kernels.fused_optimizer import fused_adam_update
 
-            def fupd(w, g, m, v):
-                return fused_adam_update(w, g, m, v, alpha_t, wd, b1, b2, eps)
+            def fupd(path, w, g, m, v):
+                if not self._leaf_fused(path):
+                    gt = (g + wd * w).astype(jnp.float32)
+                    mt = b1 * m + (1.0 - b1) * gt
+                    vt = b2 * v + (1.0 - b2) * gt * gt
+                    wt = (w - alpha_t * mt / (jnp.sqrt(vt) + eps)).astype(w.dtype)
+                    return wt, mt, vt
+                def body(hp, w, g, m, v):
+                    return fused_adam_update(w, g, m, v, hp, wd, b1, b2, eps)
+                return self._shardwise(body, self._spec_for_path(path),
+                                       4, 3)(alpha_t, w, g, m, v)
 
-            out = jax.tree.map(fupd, params, grads, state["m"], state["v"])
+            out = tree_map_with_path(fupd, params, grads, state["m"],
+                                     state["v"])
             new_params, new_m, new_v = _unzip(out, 3)
             return new_params, {"m": new_m, "v": new_v}
 

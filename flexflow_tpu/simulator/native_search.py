@@ -78,7 +78,7 @@ def native_lib() -> Optional[ctypes.CDLL]:
         lib.ffsearch_anneal.restype = ctypes.c_double
         lib.ffsearch_anneal.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
             i32p, i32p, i32p, i32p,
             i32p, i32p, f64p, f64p, i64p, i64p, i64p, i64p, i64p, i64p,
@@ -229,7 +229,7 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
 
     best_rt = lib.ffsearch_anneal(
         mm.num_devices, mm.chips_per_host, mm.torus[0], mm.torus[1],
-        mm.ici_bandwidth, mm.dcn_bandwidth,
+        mm.ici_bandwidth, mm.dcn_bandwidth, cost._dtype_bytes,
         L, _ptr(a_num_inputs, ctypes.c_int32),
         _ptr(a_num_weights, ctypes.c_int32),
         max_inputs, max_weights,

@@ -66,6 +66,9 @@ class Simulator:
         self.machine = machine or TPUMachineModel()
         self.cost = cost_model or CostModel(self.machine)
         self.overlap = overlap_backward_update
+        # comm volumes in the activation dtype (bf16 halves the bytes the
+        # reference's hardcoded 4-byte model assumes, simulator.cc:200-233)
+        self.elem_bytes = self.cost._dtype_bytes
 
     def _devices_of(self, pc: ParallelConfig) -> List[int]:
         n = pc.num_parts()
@@ -111,7 +114,7 @@ class Simulator:
             if a == b:
                 src.add_next(dst)
                 return
-            tt = self.machine.transfer_time(a, b, 4.0 * volume)
+            tt = self.machine.transfer_time(a, b, self.elem_bytes * volume)
             comm = _Task(f"comm:{src.name}->{dst.name}",
                          ("link", min(a, b), max(a, b)), tt)
             src.add_next(comm)
@@ -171,6 +174,7 @@ class Simulator:
                     vol = int(np.prod([hi - lo + 1 for lo, hi in first_r]))
                     gdevs = [devs[g] for g in group]
                     # psum over the replica group: ring allreduce cost
+                    # grad allreduce stays f32 (master weights/grads)
                     upd = _Task(f"upd:{op.name}:{w.name}:{first}",
                                 ("chip", devs[first]),
                                 self.machine.allreduce_time(gdevs, 4.0 * vol))

@@ -17,14 +17,26 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _libs = {}
 
 
+def _stale(path: str) -> bool:
+    """A prebuilt .so older than its source must NOT be loaded: the C
+    ABI may have changed and a mismatched call corrupts arguments
+    silently (no crash — just wrong numbers)."""
+    src = path[:-3].replace("lib", "", 1) + ".cpp"
+    src = os.path.join(os.path.dirname(path), os.path.basename(src))
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(path)
+    except OSError:
+        return False
+
+
 def _load(name: str) -> Optional[ctypes.CDLL]:
     if name in _libs:
         return _libs[name]
     path = os.path.join(_NATIVE_DIR, name)
-    if not os.path.exists(path):
-        try:  # build on first use if the toolchain is present
-            subprocess.run(["make", "-C", _NATIVE_DIR, name], check=True,
-                           capture_output=True, timeout=120)
+    if not os.path.exists(path) or _stale(path):
+        try:  # (re)build if the toolchain is present
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-B", name],
+                           check=True, capture_output=True, timeout=120)
         except Exception:
             _libs[name] = None
             return None

@@ -32,6 +32,7 @@ struct Machine {
   int32_t chips_per_host;
   int32_t torus_x, torus_y;
   double ici_bw, dcn_bw;
+  double elem_bytes;  // activation element size (bf16=2, f32=4)
 
   int hops(int a, int b) const {
     if (a == b) return 0;
@@ -123,7 +124,7 @@ struct Sim {
   void xfer(int src_task, int dst_task, int a, int b, int64_t vol) {
     if (vol <= 0) return;
     if (a == b) { add_edge(src_task, dst_task); return; }
-    double tt = m->transfer_time(a, b, 4.0 * double(vol));
+    double tt = m->transfer_time(a, b, m->elem_bytes * double(vol));
     int c = add_task(tt, link_key(a, b));
     add_edge(src_task, c);
     add_edge(c, dst_task);
@@ -292,7 +293,7 @@ extern "C" {
 double ffsearch_anneal(
     // machine
     int32_t num_devices, int32_t chips_per_host, int32_t torus_x,
-    int32_t torus_y, double ici_bw, double dcn_bw,
+    int32_t torus_y, double ici_bw, double dcn_bw, double elem_bytes,
     // graph
     int32_t L, const int32_t* num_inputs, const int32_t* num_weights,
     int32_t max_inputs, int32_t max_weights,
@@ -314,7 +315,8 @@ double ffsearch_anneal(
     // search
     int32_t budget, double alpha, uint64_t seed, int32_t overlap,
     const int32_t* choice_init, int32_t* choice_out, double* dp_runtime_out) {
-  Machine m{num_devices, chips_per_host, torus_x, torus_y, ici_bw, dcn_bw};
+  Machine m{num_devices, chips_per_host, torus_x, torus_y, ici_bw,
+            dcn_bw, elem_bytes > 0 ? elem_bytes : 4.0};
   std::vector<OpDesc> ops(L);
   // devices pool is int64 in the ABI for alignment simplicity; narrow it.
   std::vector<int32_t> dev_pool;
