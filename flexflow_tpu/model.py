@@ -231,6 +231,19 @@ class FFModel:
         return self._append(PipelineMLP(self, input_tensor, num_stages,
                                         num_microbatches, activation, name))
 
+    def expert_mlp(self, input_tensor: Tensor, num_experts: int,
+                   hidden_size: int, capacity_factor: float = 1.25,
+                   activation: str = "relu",
+                   name: Optional[str] = None) -> Tensor:
+        """Switch-style MoE layer; config dim 1 is the EXPERT-parallel
+        degree (expert weights shard over it, GSPMD emits the token
+        all_to_all) — the SOAP hook SURVEY §2.3 marks as design headroom
+        over the reference."""
+        from .ops.moe import ExpertMLP
+        return self._append(ExpertMLP(self, input_tensor, num_experts,
+                                      hidden_size, capacity_factor,
+                                      activation, name))
+
     def mse_loss(self, logits: Tensor, labels: Tensor,
                  reduction: str = "average", name: Optional[str] = None) -> Tensor:
         return self._append(MSELoss(self, logits, labels, reduction, name))

@@ -1,0 +1,165 @@
+"""Mixture-of-Experts operator with expert parallelism.
+
+The reference has no MoE (SURVEY §2.3 "absent in reference"), but its
+SOAP abstraction — partition any tensor dim of any op — is exactly the
+hook expert parallelism needs: this op makes the EXPERT dim an explicit
+partitionable axis the same way PipelineMLP exposes the operator dim
+(ops/pipeline.py).  ``ParallelConfig`` dim 1 is the EXPERT-parallel
+degree: expert weights shard over it, and XLA GSPMD emits the
+token all_to_all (dispatch) + all_to_all (combine) pair over those mesh
+axes from the sharding annotations alone — the TPU-native equivalent of
+hand-written NCCL alltoall in GPU MoE stacks.
+
+Routing is Switch-style top-1 with a capacity limit: per token,
+``argmax(softmax(x @ router))`` picks the expert; tokens beyond
+``capacity = ceil(tokens/E · capacity_factor)`` are dropped (output 0 —
+callers add the residual).  Dispatch/combine are dense one-hot einsums:
+static shapes, MXU-friendly, deterministic under any sharding — so
+strategies change placement, not results.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import FwdCtx, Op
+from ..initializers import DefaultWeightInitializer, ZeroInitializer
+
+
+class ExpertMLP(Op):
+    _type = "ExpertMLP"
+
+    def __init__(self, model, input_tensor, num_experts: int,
+                 hidden_size: int, capacity_factor: float = 1.25,
+                 activation: str = "relu", name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        dims = input_tensor.dims
+        d = dims[-1]
+        self.num_experts = int(num_experts)
+        self.hidden_size = int(hidden_size)
+        self.capacity_factor = float(capacity_factor)
+        self.activation = activation
+        e, h = self.num_experts, self.hidden_size
+        # expert (leading) dim partitions over config dim 1 — the
+        # expert-parallel degree; the router stays replicated.
+        self._add_weight("router", (d, e), DefaultWeightInitializer())
+        self._add_weight("w_in", (e, d, h), DefaultWeightInitializer(),
+                         partition_dims=(1, None, None))
+        self._add_weight("b_in", (e, h), ZeroInitializer(),
+                         partition_dims=(1, None))
+        self._add_weight("w_out", (e, h, d), DefaultWeightInitializer(),
+                         partition_dims=(1, None, None))
+        self._add_weight("b_out", (e, d), ZeroInitializer(),
+                         partition_dims=(1, None))
+        self._add_output(dims, input_tensor.dtype)
+
+    # -- config semantics (mirrors PipelineMLP's non-layout dim 1) ------
+    def _config_dim_bound(self, i: int):
+        """Config dim 1 is the EXPERT-parallel degree: legal iff it
+        divides ``num_experts`` — not the tensor dim the base size check
+        would compare against."""
+        if i == 1:
+            return self.num_experts
+        return super()._config_dim_bound(i)
+
+    def constraint_pc(self):
+        """Output activations are batch-sharded only; the expert degree
+        places weights, not outputs."""
+        from ..config import ParallelConfig
+
+        dims = (self.pc.dims[0],) + (1,) * (self.output.num_dims - 1)
+        return ParallelConfig(dims=dims)
+
+    def _ep_axes(self):
+        pc = getattr(self, "pc", None)
+        machine = self.model.machine
+        if (pc is None or len(pc.dims) < 2 or pc.dims[1] <= 1
+                or machine is None or machine.num_devices <= 1):
+            return None
+        try:
+            groups = machine.axes_for_degrees([pc.dims[0], pc.dims[1]])
+        except ValueError:
+            return None
+        return groups[1] or None
+
+    def capacity(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.num_experts
+                                * self.capacity_factor))
+
+    def forward(self, params, xs: List[jax.Array], ctx: FwdCtx):
+        x = xs[0]
+        shape = x.shape
+        d = shape[-1]
+        dt = x.dtype
+        s = 1
+        for dim in shape[:-1]:
+            s *= dim
+        xf = x.reshape(s, d)
+        e = params["w_in"].shape[0]
+        cap = self.capacity(s)
+
+        # Router in f32: top-1 gate per token (Switch).
+        logits = jnp.dot(xf.astype(jnp.float32),
+                         params["router"].astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)            # (S, E)
+        expert_idx = jnp.argmax(gates, axis=-1)            # (S,)
+        gate = jnp.max(gates, axis=-1)                     # (S,)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        # position of each token in its expert's queue (capacity cut)
+        pos = jnp.cumsum(onehot, axis=0) * onehot          # 1-based
+        keep = (pos > 0) & (pos <= cap)
+        pos_idx = jnp.clip(pos - 1.0, 0, cap - 1).astype(jnp.int32)
+        slot = jax.nn.one_hot(jnp.max(pos_idx, axis=-1), cap,
+                              dtype=jnp.float32)           # (S, C)
+        disp = (onehot * keep).astype(jnp.float32)[:, :, None] \
+            * slot[:, None, :]                             # (S, E, C)
+
+        cons = self._expert_constraint
+        expert_in = cons(jnp.einsum("sec,sd->ecd", disp,
+                                    xf.astype(jnp.float32)))
+        hmid = jnp.einsum("ecd,edh->ech", expert_in.astype(dt),
+                          params["w_in"].astype(dt))
+        hmid = hmid + params["b_in"].astype(hmid.dtype)[:, None, :]
+        if self.activation == "relu":
+            hmid = jax.nn.relu(hmid)
+        elif self.activation == "gelu":
+            hmid = jax.nn.gelu(hmid)
+        hmid = cons(hmid)
+        y_e = jnp.einsum("ech,ehd->ecd", hmid, params["w_out"].astype(dt))
+        y_e = y_e + params["b_out"].astype(y_e.dtype)[:, None, :]
+        y_e = cons(y_e)
+        comb = disp * gate[:, None, None]                  # (S, E, C)
+        y = jnp.einsum("sec,ecd->sd", comb,
+                       y_e.astype(jnp.float32)).astype(dt)
+        return [y.reshape(shape)]
+
+    def _expert_constraint(self, a):
+        """Pin the expert dim of (E, C, ...) intermediates to the ep mesh
+        axes so GSPMD places per-expert compute on its shard (and emits
+        the all_to_all at the dispatch/combine einsums)."""
+        axes = self._ep_axes()
+        if axes is None:
+            return a
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(axes if len(axes) > 1 else axes[0],
+                             *([None] * (a.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(self.model.machine.mesh, spec))
+
+    def flops_per_sample(self):
+        dims = self.output.dims
+        d = dims[-1]
+        tokens_per_sample = 1
+        for dim in dims[1:-1]:
+            tokens_per_sample *= dim
+        h = self.hidden_size
+        # router + one expert's in+out projections per token (capacity
+        # overhead included)
+        return tokens_per_sample * (
+            2.0 * d * self.num_experts
+            + self.capacity_factor * 4.0 * d * h)

@@ -50,6 +50,7 @@ _SPLITTABLE = {
     "LSTM": (0, 2),            # batch + hidden TP (T stays sequential)
     "MSELoss": (0,),
     "PipelineMLP": (0, 1),     # dim 1 = pipeline (operator-dim) degree
+    "ExpertMLP": (0, 1),       # dim 1 = expert-parallel degree
 }
 
 

@@ -978,6 +978,24 @@ flexflow_tensor_t flexflow_model_add_conv2d_v2(
   return out;
 }
 
+flexflow_tensor_t flexflow_model_add_expert_mlp(
+    flexflow_model_t m, flexflow_tensor_t input, int num_experts,
+    int hidden_size, double capacity_factor, const char* name) {
+  flexflow_tensor_t out{nullptr};
+  PyObject* kw = Py_BuildValue("{s:d}", "capacity_factor", capacity_factor);
+  if (name) {
+    PyObject* n = PyUnicode_FromString(name);
+    PyDict_SetItemString(kw, "name", n);
+    Py_DECREF(n);
+  }
+  out.impl = call(H(m.impl), "expert_mlp",
+                  Py_BuildValue("(Oii)", H(input.impl), num_experts,
+                                hidden_size),
+                  kw);
+  Py_DECREF(kw);
+  return out;
+}
+
 /* ---- NetConfig ------------------------------------------------------ */
 
 flexflow_net_config_t flexflow_net_config_create(void) {

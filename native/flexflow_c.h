@@ -241,6 +241,11 @@ flexflow_tensor_t flexflow_model_add_conv2d_v2(
     flexflow_initializer_t kernel_init, flexflow_initializer_t bias_init,
     const char* name);
 
+/* Switch-style MoE layer; expert weights shard over config dim 1 */
+flexflow_tensor_t flexflow_model_add_expert_mlp(
+    flexflow_model_t m, flexflow_tensor_t input, int num_experts,
+    int hidden_size, double capacity_factor, const char* name);
+
 /* NetConfig (reference: --dataset flag carrier) */
 flexflow_net_config_t flexflow_net_config_create(void);
 void flexflow_net_config_destroy(flexflow_net_config_t c);
