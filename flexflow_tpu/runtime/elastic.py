@@ -1,0 +1,131 @@
+"""Elastic training: auto-resume + accelerator-hang detection.
+
+The reference is strictly fail-stop — any CUDA error aborts the process
+(FatalError, cuda_helper.h:6-36) and nothing is checkpointed (SURVEY
+§5.3/5.4).  TPU jobs get preempted and tunnels/pods can wedge (every op
+hangs without erroring), so this module adds the two recovery pieces a
+long-running training needs:
+
+  * ``elastic_train`` — drives the epoch loop through a
+    ``CheckpointManager``: restores the latest checkpoint on start,
+    fast-forwards the dataloader's shuffle stream to the resume point
+    (bitwise-identical continuation), saves on an interval, and makes a
+    best-effort save on the way out of a failure when the device still
+    answers;
+  * ``StepWatchdog`` — runs device sync points on a worker thread with
+    a wall-clock deadline: a hung accelerator (blocked inside a C call
+    that no signal or async-exception can interrupt) leaves the worker
+    stranded and raises ``DeviceHangError`` in the DRIVING thread, which
+    regains control — fail-DETECT, where the reference only fail-stops.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .checkpoint import CheckpointManager
+
+
+class DeviceHangError(RuntimeError):
+    """The accelerator did not answer within the watchdog deadline."""
+
+
+class StepWatchdog:
+    """Deadline wrapper for calls that may block forever in device code.
+
+    Usage::
+
+        wd = StepWatchdog(timeout=120)
+        wd.run(model.sync)     # raises DeviceHangError after 120 s
+    """
+
+    def __init__(self, timeout: float):
+        self.timeout = float(timeout)
+
+    def run(self, fn: Callable, *args, **kwargs):
+        box: dict = {}
+
+        def worker():
+            try:
+                box["value"] = fn(*args, **kwargs)
+            except BaseException as e:  # propagate into the caller
+                box["exc"] = e
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        t.join(self.timeout)
+        if t.is_alive():
+            # the worker stays stranded on the blocked C call (daemon:
+            # it cannot be cancelled, only abandoned)
+            raise DeviceHangError(
+                f"device unresponsive for {self.timeout:.0f}s")
+        if "exc" in box:
+            raise box["exc"]
+        return box.get("value")
+
+
+def elastic_train(model, dataloader, epochs: int,
+                  checkpoint_dir: str,
+                  save_every_epochs: int = 1,
+                  max_to_keep: int = 3,
+                  step_timeout: Optional[float] = None,
+                  on_epoch: Optional[Callable[[int, object], None]] = None,
+                  save_on_failure: bool = True) -> int:
+    """Run (or resume) an epoch training loop with checkpoint rotation.
+
+    Returns the number of epochs actually executed in THIS invocation.
+    Restart the process after a crash/preemption and call again with the
+    same arguments: training continues from the last saved epoch with
+    the same RNG/data streams (the loader's shuffle stream is
+    fast-forwarded past completed epochs, and the step counter drives
+    the per-step RNG fold), so the resumed run is numerically identical
+    to an uninterrupted one.
+    """
+    mgr = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+    wd = StepWatchdog(step_timeout) if step_timeout else None
+    sync = (lambda: wd.run(model.sync)) if wd else model.sync
+    steps_per_epoch = dataloader.num_batches()
+    restored = mgr.restore_latest(model)
+    start_epoch = 0
+    if restored is not None:
+        start_epoch = model._step_count // max(1, steps_per_epoch)
+    # fast-forward the shuffle stream and the optimizer's epoch schedule
+    # (Adam bias correction) past completed epochs so the resumed run
+    # consumes exactly the batches/updates the original would have
+    for _ in range(start_epoch):
+        dataloader.reset()
+        if model.optimizer is not None:
+            model.optimizer.next_epoch()
+    ran = 0
+    try:
+        for epoch in range(start_epoch, epochs):
+            dataloader.reset()
+            model.reset_metrics()
+            for _ in range(steps_per_epoch):
+                dataloader.next_batch(model)
+                model.train_iteration()
+            sync()
+            if model.optimizer is not None:
+                model.optimizer.next_epoch()
+            ran += 1
+            if on_epoch is not None:
+                on_epoch(epoch, model.get_metrics())
+            if (epoch + 1 - start_epoch) % save_every_epochs == 0 \
+                    or epoch + 1 == epochs:
+                mgr.save(model, step=epoch + 1)
+        mgr.wait_until_finished()
+    except DeviceHangError:
+        raise  # device gone: state on it is unreachable, nothing to save
+    except BaseException:
+        if save_on_failure:
+            try:
+                sync()
+                mgr.save(model, step=start_epoch + ran)
+                mgr.wait_until_finished()
+            except Exception:
+                pass  # best effort — the original failure propagates
+        raise
+    finally:
+        mgr.close()
+    return ran

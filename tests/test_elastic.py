@@ -1,0 +1,92 @@
+"""Elastic training: auto-resume and hang detection (runtime/elastic.py).
+
+Beyond the reference (fail-stop, no checkpointing — SURVEY §5.3/5.4):
+a resumed run must be numerically identical to an uninterrupted one,
+and a wedged device must surface as DeviceHangError instead of an
+infinite block.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.runtime.elastic import (DeviceHangError, StepWatchdog,
+                                          elastic_train)
+
+
+def _build(opt="adam"):
+    cfg = ff.FFConfig(batch_size=16)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 8), nchw=False, name="input")
+    t = m.dense(inp, 16, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.softmax(t, name="sm")
+    optimizer = (ff.AdamOptimizer(alpha=0.01) if opt == "adam"
+                 else ff.SGDOptimizer(lr=0.1, momentum=0.9))
+    m.compile(optimizer, "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=9)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((48, 8), dtype=np.float32)
+    y = rng.integers(0, 4, size=(48, 1), dtype=np.int32)
+    dl = ff.DataLoader(m, {inp: x}, y, seed=5)
+    return m, dl
+
+
+@pytest.mark.parametrize("opt", ["adam", "sgd"])
+def test_resume_matches_uninterrupted(tmp_path, devices, opt):
+    """2 epochs + restart + 2 more == 4 straight epochs, bitwise-close
+    (same shuffle stream, same per-step RNG, same Adam schedule)."""
+    ck1 = str(tmp_path / "ck_interrupted")
+    m1, dl1 = _build(opt)
+    ran = elastic_train(m1, dl1, epochs=2, checkpoint_dir=ck1)
+    assert ran == 2
+    # "process restart": fresh model + loader, same checkpoint dir
+    m2, dl2 = _build(opt)
+    ran = elastic_train(m2, dl2, epochs=4, checkpoint_dir=ck1)
+    assert ran == 2  # only the remaining epochs execute
+
+    m3, dl3 = _build(opt)
+    ran = elastic_train(m3, dl3, epochs=4,
+                        checkpoint_dir=str(tmp_path / "ck_straight"))
+    assert ran == 4
+    np.testing.assert_allclose(m2.get_parameter("fc1", "kernel"),
+                               m3.get_parameter("fc1", "kernel"),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(m2.get_parameter("fc2", "kernel"),
+                               m3.get_parameter("fc2", "kernel"),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_failure_saves_then_propagates(tmp_path, devices):
+    """An exception mid-training still leaves a usable checkpoint."""
+    m, dl = _build()
+    boom = RuntimeError("injected failure")
+
+    def on_epoch(epoch, metrics):
+        if epoch == 1:
+            raise boom
+
+    with pytest.raises(RuntimeError, match="injected failure"):
+        elastic_train(m, dl, epochs=4, checkpoint_dir=str(tmp_path / "ck"),
+                      on_epoch=on_epoch)
+    m2, dl2 = _build()
+    ran = elastic_train(m2, dl2, epochs=4,
+                        checkpoint_dir=str(tmp_path / "ck"))
+    assert 0 < ran < 4  # resumed from the mid-failure save
+
+
+def test_watchdog_detects_hang():
+    wd = StepWatchdog(timeout=0.3)
+    t0 = time.perf_counter()
+    with pytest.raises(DeviceHangError):
+        wd.run(time.sleep, 5.0)  # stands in for a blocked device_get
+    assert time.perf_counter() - t0 < 2.0  # caller regained control fast
+
+
+def test_watchdog_passes_through_results_and_errors():
+    wd = StepWatchdog(timeout=5.0)
+    assert wd.run(lambda: 42) == 42
+    with pytest.raises(ValueError):
+        wd.run(lambda: (_ for _ in ()).throw(ValueError("x")))
