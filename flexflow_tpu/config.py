@@ -140,10 +140,14 @@ class FFConfig:
     # float32 for numerics tests).
     compute_dtype: str = "float32"
     # Route optimizer updates through the fused Pallas kernels
-    # (kernels/fused_optimizer.py ≈ reference optimizer_kernel.cu).
-    # Only takes effect on single-device machines — Pallas calls are not
-    # GSPMD-partitionable, so sharded runs keep the jnp path.
+    # (kernels/fused_optimizer.py ≈ reference optimizer_kernel.cu); on a
+    # mesh each parameter updates per-shard via a per-leaf shard_map.
     fused_optimizer: bool = False
+    # ZeRO-1: shard optimizer state (momentum / Adam moments) over the
+    # mesh axes the parameter itself does not occupy — replicated-param
+    # state drops to ~1/N per device.  Beyond the reference (SURVEY §2.3
+    # lists ZeRO-style optimizer sharding as design headroom).
+    zero_optimizer: bool = False
     # Per-op strategies, keyed by op name (the reference keys an equivalent
     # map by hash(op name) — include/config.h:102, strategy.cc:23-26; the
     # hash is an implementation detail of Legion mapper tags that the TPU
@@ -220,6 +224,8 @@ class FFConfig:
                 self.compute_dtype = "bfloat16"
             elif a == "--fused-optimizer":
                 self.fused_optimizer = True
+            elif a == "--zero-optimizer":
+                self.zero_optimizer = True
             else:
                 rest.append(a)
             i += 1

@@ -853,18 +853,77 @@ class FFModel:
             # Host-offloaded leaves take the plain update (their streaming
             # device_put pairs don't model Pallas aliasing); every other
             # leaf keeps the fused path.
+            nonfused = set(self._offload)
+            zero_specs = (self._zero_state_specs()
+                          if self.config.zero_optimizer and multi else None)
+            if zero_specs:
+                # state spec != param spec breaks the fused kernels'
+                # same-spec shard_map; those leaves take the plain update
+                nonfused |= set(zero_specs)
             self.optimizer.set_mesh(self.machine.mesh if multi else None,
-                                    specs,
-                                    nonfused_paths=set(self._offload))
+                                    specs, nonfused_paths=nonfused)
+            self.optimizer.zero_specs = zero_specs
         self._opt_state = (self._init_opt_state()
                            if self.optimizer is not None else None)
         self._step_count = 0
 
+    def _zero_state_specs(self):
+        """ZeRO-1 layout: shard each parameter's OPTIMIZER STATE over the
+        mesh axes the parameter itself does not occupy (momentum/moments
+        of replicated weights drop to ~1/N per device; the update's
+        gather/scatter comes out of GSPMD).  Only leaves whose leading
+        dim is unsharded and divisible participate; offloaded leaves are
+        host-resident already.  Returns {(op, weight): PartitionSpec}."""
+        out = {}
+        mesh = self.machine.mesh
+        for op in self.ops:
+            if not op.weights or op.name not in self._params:
+                continue
+            for w in op.weights:
+                if (op.name, w.name) in self._offload:
+                    continue
+                arr = self._params[op.name].get(w.name)
+                if arr is None:
+                    continue
+                spec = arr.sharding.spec
+                used = set()
+                for e in spec:
+                    if e is None:
+                        continue
+                    used.update(e if isinstance(e, tuple) else (e,))
+                free = [a for a in mesh.axis_names if a not in used]
+                if not free:
+                    continue
+                n_free = 1
+                for a in free:
+                    n_free *= mesh.shape[a]
+                dim0 = (spec[0] if len(spec) > 0 else None)
+                if dim0 is not None or arr.shape[0] % n_free != 0:
+                    continue
+                entries = list(spec) + [None] * (arr.ndim - len(spec))
+                entries[0] = tuple(free) if len(free) > 1 else free[0]
+                while entries and entries[-1] is None:
+                    entries.pop()
+                out[(op.name, w.name)] = PartitionSpec(*entries)
+        return out
+
     def _init_opt_state(self):
         # zeros_like does not carry memory kinds: pin offloaded entries'
         # state to host explicitly so every step sees consistent kinds.
-        return self._offload_put_state(self.optimizer.init_state(self._params),
-                                       True)
+        state = self._offload_put_state(self.optimizer.init_state(self._params),
+                                        True)
+        zero_specs = getattr(self.optimizer, "zero_specs", None)
+        if zero_specs:
+            mesh = self.machine.mesh
+            state = {
+                k: ({opn: {wn: (jax.device_put(
+                        a, NamedSharding(mesh, zero_specs[(opn, wn)]))
+                        if (opn, wn) in zero_specs else a)
+                     for wn, a in ws.items()}
+                     for opn, ws in v.items()}
+                    if isinstance(v, dict) else v)
+                for k, v in state.items()}
+        return state
 
     # ------------------------------------------------------------------
     # forward-graph evaluation (inside jit)

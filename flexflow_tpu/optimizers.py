@@ -51,6 +51,7 @@ class Optimizer:
     mesh = None
     param_specs = None
     nonfused_paths: frozenset = frozenset()
+    zero_specs = None  # ZeRO-1: {(op, weight): PartitionSpec} for STATE
 
     def set_mesh(self, mesh, param_specs, nonfused_paths=()) -> None:
         """``nonfused_paths``: (op_name, weight_name) leaves that must
@@ -66,6 +67,28 @@ class Optimizer:
         except AttributeError:
             return True
         return key not in self.nonfused_paths
+
+    def _constrain_state(self, tree):
+        """Pin a params-shaped state subtree to the ZeRO-1 shardings so
+        the computed state stays sharded between steps (not
+        materialized replicated and resharded on re-entry)."""
+        if not self.zero_specs or self.mesh is None:
+            return tree
+        from jax.sharding import NamedSharding
+        from jax.tree_util import tree_map_with_path
+
+        def f(path, x):
+            try:
+                key = tuple(p.key for p in path)
+            except AttributeError:
+                return x
+            spec = self.zero_specs.get(key)
+            if spec is None:
+                return x
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, spec))
+
+        return tree_map_with_path(f, tree)
 
     def _spec_for_path(self, path):
         """PartitionSpec for a params-tree key path (PartitionSpec is a
@@ -161,7 +184,7 @@ class SGDOptimizer(Optimizer):
 
                 out = tree_map_with_path(fupd, params, grads, state["v"])
                 new_params, new_v = _unzip(out, 2)
-                return new_params, {"v": new_v}
+                return new_params, {"v": self._constrain_state(new_v)}
 
             def fupd_plain(path, w, g):
                 if not self._leaf_fused(path):
@@ -183,7 +206,7 @@ class SGDOptimizer(Optimizer):
 
             out = jax.tree.map(upd, params, grads, state["v"])
             new_params, new_v = _unzip(out, 2)
-            return new_params, {"v": new_v}
+            return new_params, {"v": self._constrain_state(new_v)}
 
         def upd_plain(w, g):
             return w - lr * (g + wd * w).astype(w.dtype)
@@ -244,7 +267,8 @@ class AdamOptimizer(Optimizer):
             out = tree_map_with_path(fupd, params, grads, state["m"],
                                      state["v"])
             new_params, new_m, new_v = _unzip(out, 3)
-            return new_params, {"m": new_m, "v": new_v}
+            return new_params, {"m": self._constrain_state(new_m),
+                                "v": self._constrain_state(new_v)}
 
         def upd(w, g, m, v):
             gt = (g + wd * w).astype(jnp.float32)
@@ -254,4 +278,5 @@ class AdamOptimizer(Optimizer):
 
         out = jax.tree.map(upd, params, grads, state["m"], state["v"])
         new_params, new_m, new_v = _unzip(out, 3)
-        return new_params, {"m": new_m, "v": new_v}
+        return new_params, {"m": self._constrain_state(new_m),
+                            "v": self._constrain_state(new_v)}
