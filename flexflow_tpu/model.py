@@ -310,61 +310,21 @@ class FFModel:
                     "pipeline stages must be a contiguous in-order "
                     "partition of the op graph (minus a trailing Softmax)")
         else:
-            S = min(req["num_stages"], len(seg))
-            # Balance contiguous stages by cumulative per-op FLOPs (the
-            # reference balances by hand; nmt.cc splits encoder/decoder).
-            costs = [max(op.flops_per_sample(), 1.0) for op in seg]
-            total = sum(costs)
-            stages, acc, cur = [], 0.0, []
-            for idx, (op, c) in enumerate(zip(seg, costs)):
-                cur.append(op)
-                acc += c
-                ops_left = len(seg) - idx - 1
-                stages_left = S - len(stages) - 1
-                if len(stages) < S - 1 and (
-                        acc >= total * (len(stages) + 1) / S
-                        or ops_left <= stages_left):
-                    stages.append(cur)
-                    cur = []
-            if cur:
-                stages.append(cur)
-            stages = [g for g in stages if g]
+            from .parallel.pipeline_plan import balanced_stages
+
+            stages = balanced_stages(seg, req["num_stages"])
         S = len(stages)
 
         # Validate dataflow FIRST (structural errors surface regardless of
         # whether a ring is expressible): one boundary tensor between
         # consecutive stages; nothing else crosses a stage or escapes.
-        const_guids = set(self._constants.keys())
-        stage_of: Dict[int, int] = {}
-        for si, g in enumerate(stages):
-            for op in g:
-                for t in op.outputs:
-                    stage_of[t.guid] = si
+        # Shared with the stage-assignment search so it never recommends
+        # a plan this planner would reject.
+        from .parallel.pipeline_plan import validate_stages
+
+        validate_stages(stages, tail, set(self._constants.keys()))
         seg_in = stages[0][0].inputs[0]
-        boundaries: List[Tensor] = []
-        for si, g in enumerate(stages):
-            expected = seg_in if si == 0 else boundaries[si - 1]
-            for op in g:
-                for t in op.inputs:
-                    if t.guid in const_guids or t.guid == expected.guid:
-                        continue
-                    if stage_of.get(t.guid) == si:
-                        continue
-                    raise ValueError(
-                        f"pipeline: op {op.name} (stage {si}) consumes "
-                        f"tensor from stage {stage_of.get(t.guid)} that is "
-                        f"not the stage boundary; re-partition the stages")
-            out_t = g[-1].output
-            if si < S - 1:
-                boundaries.append(out_t)
         final_out = stages[-1][-1].output
-        # nothing produced inside may be consumed after the segment except
-        # the final output
-        inner = set(stage_of.keys()) - {final_out.guid}
-        for op in tail:
-            for t in op.inputs:
-                if t.guid in inner:
-                    raise ValueError("pipeline: tensor escapes the segment")
 
         import math
         degree = req["degree"] if req["degree"] else S

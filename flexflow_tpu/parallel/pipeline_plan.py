@@ -1,0 +1,70 @@
+"""Shared pipeline stage planning: balancing + dataflow validation.
+
+One source of truth for BOTH the runtime planner (FFModel._plan_pipeline
+→ set_pipeline execution) and the stage-assignment search
+(simulator/pipeline_search.py) — if the two disagreed, the search would
+cost plans the runtime cannot run (or balance them differently than it
+executes them).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+
+def balanced_stages(ops: Sequence, num_stages: int) -> List[List]:
+    """Contiguous partition of ``ops`` into ≤ ``num_stages`` groups with
+    roughly equal cumulative per-op FLOPs (the reference balances by
+    hand; nmt.cc splits encoder/decoder)."""
+    S = min(num_stages, len(ops))
+    costs = [max(op.flops_per_sample(), 1.0) for op in ops]
+    total = sum(costs)
+    stages, acc, cur = [], 0.0, []
+    for idx, (op, c) in enumerate(zip(ops, costs)):
+        cur.append(op)
+        acc += c
+        ops_left = len(ops) - idx - 1
+        stages_left = S - len(stages) - 1
+        if len(stages) < S - 1 and (
+                acc >= total * (len(stages) + 1) / S
+                or ops_left <= stages_left):
+            stages.append(cur)
+            cur = []
+    if cur:
+        stages.append(cur)
+    return [g for g in stages if g]
+
+
+def validate_stages(stages: List[List], tail: Sequence,
+                    const_guids) -> None:
+    """Dataflow rules of the GPipe ring (one boundary tensor between
+    consecutive stages; nothing else crosses a stage or escapes).
+    Raises ``ValueError`` on violation."""
+    S = len(stages)
+    stage_of: Dict[int, int] = {}
+    for si, g in enumerate(stages):
+        for op in g:
+            for t in op.outputs:
+                stage_of[t.guid] = si
+    seg_in = stages[0][0].inputs[0]
+    boundaries = []
+    for si, g in enumerate(stages):
+        expected = seg_in if si == 0 else boundaries[si - 1]
+        for op in g:
+            for t in op.inputs:
+                if t.guid in const_guids or t.guid == expected.guid:
+                    continue
+                if stage_of.get(t.guid) == si:
+                    continue
+                raise ValueError(
+                    f"pipeline: op {op.name} (stage {si}) consumes "
+                    f"tensor from stage {stage_of.get(t.guid)} that is "
+                    f"not the stage boundary; re-partition the stages")
+        if si < S - 1:
+            boundaries.append(g[-1].output)
+    final_out = stages[-1][-1].output
+    inner = set(stage_of.keys()) - {final_out.guid}
+    for op in tail:
+        for t in op.inputs:
+            if t.guid in inner:
+                raise ValueError("pipeline: tensor escapes the segment")

@@ -109,12 +109,30 @@ def _load_npz(model, path: str) -> None:
     state = rebuild(_tree_from_model(model))
     # Re-place arrays with the model's shardings.
     spec_tree = model._param_spec_tree()
-    placed = {}
-    for opn, ws in state["params"].items():
-        shards = spec_tree.get(opn, {})
-        placed[opn] = {wn: jax.device_put(a, shards[wn]) if wn in shards else a
-                       for wn, a in ws.items()}
-    state["params"] = placed
+
+    def place_params_like(tree, zero_specs=None):
+        placed = {}
+        for opn, ws in tree.items():
+            shards = spec_tree.get(opn, {})
+            placed[opn] = {}
+            for wn, a in ws.items():
+                sh = shards.get(wn)
+                if zero_specs and (opn, wn) in zero_specs:
+                    from jax.sharding import NamedSharding
+                    sh = NamedSharding(model.machine.mesh,
+                                       zero_specs[(opn, wn)])
+                placed[opn][wn] = jax.device_put(a, sh) if sh else a
+        return placed
+
+    state["params"] = place_params_like(state["params"])
+    if "opt_state" in state and isinstance(state["opt_state"], dict):
+        # optimizer slots re-take their param's sharding — or the ZeRO-1
+        # layout when the optimizer carries zero_specs
+        zs = getattr(model.optimizer, "zero_specs", None) \
+            if model.optimizer is not None else None
+        state["opt_state"] = {
+            k: (place_params_like(v, zs) if isinstance(v, dict) else v)
+            for k, v in state["opt_state"].items()}
     _apply_tree(model, state)
 
 

@@ -1,0 +1,181 @@
+"""Pipeline (operator-placement) search: make stage assignment part of
+the searched space.
+
+The reference's searched space and its placement mechanism are one
+thing — ParallelConfig device lists cover operator placement, so its
+MCMC can discover pipeline-ish layouts (nmt/nmt.cc:269-308 encodes them
+by hand).  Here the dim-degree search (search.py / ffsearch.cpp) covers
+per-op SOAP dims, and this module extends it over the OTHER axis:
+contiguous stage assignments executed by ``FFModel.set_pipeline``.
+
+Cost model for a dp×pp plan with S ring slots and M microbatches
+(GPipe, parallel/pipeline.py semantics):
+
+    t_slot   = per-microbatch fwd+bwd time of the slowest slot
+               (per-op costs from the measured/calibrated CostModel at
+               the dp-sharded, microbatched sub-shape)
+    t_comm   = boundary buffer ppermute per tick (padded to the largest
+               flattened boundary — exactly what the runtime ships)
+    t_pipe   = (M + S - 1) · (t_slot + t_comm)   + weight-sync allreduce
+
+The searcher sweeps the (S, dp, M) grid (S·dp = devices), costs each
+plan, and returns the best alongside the pure dim-search baseline so
+``suggest_parallelization`` can answer: data-parallel, SOAP dims, or
+pipeline?
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import ParallelConfig
+from .cost_model import CostModel
+from .machine import TPUMachineModel
+
+
+def _pipeline_segment(model):
+    """(segment ops, tail ops) set_pipeline would use, or None when the
+    chain has unsupported structure."""
+    seg = list(model.ops)
+    tail = []
+    while seg and seg[-1]._type == "Softmax":
+        tail.insert(0, seg.pop())
+    if len(seg) < 2:
+        return None
+    for op in seg:
+        if op.init_stats():
+            return None  # running stats unsupported in the ring
+    return seg, tail
+
+
+def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
+                       S: int, dp: int, microbatches: int) -> Optional[dict]:
+    """{"t": simulated seconds/iteration, "m": the ADJUSTED microbatch
+    count the plan actually uses} for a dp×S GPipe plan, or None when
+    the plan is not executable (branching dataflow the ring cannot
+    carry, or shapes/batch that don't divide) — validated with the SAME
+    rules FFModel._plan_pipeline enforces."""
+    from ..parallel.pipeline_plan import balanced_stages, validate_stages
+
+    pair = _pipeline_segment(model)
+    if pair is None or S < 2:
+        return None
+    seg, tail = pair
+    batch = model.ops[0].output.dims[0]
+    if batch % dp != 0:
+        return None
+    local_b = batch // dp
+    M = min(microbatches, local_b)
+    while local_b % M != 0:
+        M -= 1
+    mb = local_b // M
+    if mb < 1:
+        return None
+    stages = balanced_stages(seg, S)
+    if len(stages) != S:
+        return None
+    try:
+        validate_stages(stages, tail, set(model._constants.keys()))
+    except ValueError:
+        return None  # branching graph: the ring can't carry this partition
+
+    # per-slot per-microbatch compute: cost the op at batch degree
+    # batch/mb (so the sub-shape's leading dim is the microbatch size)
+    slot_t = []
+    for g in stages:
+        t = 0.0
+        for op in g:
+            deg0 = max(1, op.output.dims[0] // mb)
+            pc = ParallelConfig(dims=(deg0,) + (1,) * (op.output.num_dims - 1))
+            pc = op.legalize_pc(pc)
+            t += cost.op_time(op, pc, "forward")
+            t += cost.op_time(op, pc, "backward")
+        slot_t.append(t)
+    t_slot = max(slot_t)
+
+    # boundary ring: buffers pad to the largest flattened boundary
+    bounds = [int(np.prod(stages[0][0].inputs[0].dims[1:]))]
+    bounds += [int(np.prod(g[-1].output.dims[1:])) for g in stages]
+    pad = max(bounds)
+    t_comm = machine.transfer_time(0, 1, cost._dtype_bytes * mb * pad)
+
+    t_pipe = (M + S - 1) * (t_slot + t_comm)
+
+    # weight sync: dp-replica grad allreduce of each slot's weights
+    # (stage weights live only on their slot — model._plan_pipeline_pack)
+    if dp > 1:
+        w_elems = max(
+            sum(w.volume() for op in g for w in op.weights) for g in stages)
+        t_pipe += machine.allreduce_time(list(range(dp)), 4.0 * w_elems)
+    return {"t": t_pipe, "m": M}
+
+
+def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
+                    microbatches: int = 4,
+                    compute_dtype: Optional[str] = None) -> Optional[Dict]:
+    """Best (S, dp, M) pipeline plan over the machine, or None when no
+    executable plan exists.  Returns {"num_stages", "dp_degree",
+    "num_microbatches", "simulated_s"}."""
+    nd = model.machine.num_devices if model.machine is not None \
+        else model.config.num_devices
+    mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
+    dtype = compute_dtype or model.config.compute_dtype
+    cost = CostModel(mm, measure=False, compute_dtype=dtype)
+    best = None
+    for S in [d for d in range(2, nd + 1) if nd % d == 0]:
+        dp = nd // S
+        for M in {microbatches, 2 * microbatches}:
+            r = cost_pipeline_plan(model, mm, cost, S, dp, M)
+            if r is not None and (best is None
+                                  or r["t"] < best["simulated_s"]):
+                # report the ADJUSTED microbatch count the costing used —
+                # the requested one may not divide the local batch
+                best = {"num_stages": S, "dp_degree": dp,
+                        "num_microbatches": r["m"], "simulated_s": r["t"]}
+    return best
+
+
+def suggest_parallelization(model, budget: int = 2000,
+                            machine_model: Optional[TPUMachineModel] = None,
+                            seed: int = 0, microbatches: int = 4) -> Dict:
+    """Search BOTH spaces — per-op SOAP dims and pipeline stage
+    assignment — and return the faster plan:
+
+        {"kind": "dims"|"pipeline", "simulated_s": t,
+         "strategies": {...} | "pipeline": {...},
+         "alternatives": {"dims_s": t1, "pipeline_s": t2}}
+    """
+    from .native_search import native_mcmc_search
+    from .search import mcmc_search
+    from .simulator import Simulator
+
+    nd = model.machine.num_devices if model.machine is not None \
+        else model.config.num_devices
+    mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
+    cost = CostModel(mm, measure=False,
+                     compute_dtype=model.config.compute_dtype)
+    sim = Simulator(mm, cost)
+
+    best_dims = None
+    r = native_mcmc_search(model, budget=budget, machine_model=mm,
+                           seed=seed, verbose=False)
+    if r is not None:
+        best_dims = r[0]
+    if best_dims is None:
+        best_dims = mcmc_search(model, budget=budget, machine_model=mm,
+                                seed=seed, verbose=False)
+    dims_t = sim.simulate_runtime(model, best_dims)
+
+    pipe = search_pipeline(model, machine_model=mm,
+                           microbatches=microbatches)
+    out = {"alternatives": {"dims_s": dims_t,
+                            "pipeline_s": pipe["simulated_s"] if pipe else None}}
+    if pipe is not None and pipe["simulated_s"] < dims_t:
+        out.update(kind="pipeline", simulated_s=pipe["simulated_s"],
+                   pipeline=pipe)
+    else:
+        out.update(kind="dims", simulated_s=dims_t, strategies=best_dims)
+    return out

