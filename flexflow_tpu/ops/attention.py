@@ -106,6 +106,14 @@ class MultiHeadAttention(Op):
             y = y + params[b].astype(y.dtype)
         return y
 
+    def _config_dim_bound(self, i: int):
+        """The feature split (dim 2) is head-parallel tensor parallelism:
+        the degree must divide num_heads so each shard holds whole
+        heads (the reshape to (B, S, H, D) then stays aligned)."""
+        if i == 2:
+            return self.num_heads
+        return super()._config_dim_bound(i)
+
     def _seq_degree(self) -> int:
         pc = getattr(self, "pc", None)
         if pc is None or len(pc.dims) < 2:

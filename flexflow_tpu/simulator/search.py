@@ -51,6 +51,8 @@ _SPLITTABLE = {
     "MSELoss": (0,),
     "PipelineMLP": (0, 1),     # dim 1 = pipeline (operator-dim) degree
     "ExpertMLP": (0, 1),       # dim 1 = expert-parallel degree
+    "MultiHeadAttention": (0, 1, 2),  # batch, seq (ring), head TP
+    "LayerNorm": (0, 1),       # batch, seq
 }
 
 

@@ -1,0 +1,71 @@
+"""Per-op profile report (committed artifact for the perf story).
+
+Measures each op of a model standalone on the attached accelerator (the
+measure_compute_time analogue, runtime/profiling.op_profile) and writes
+a markdown table with fwd/bwd ms, analytic FLOPs, achieved TFLOPS and
+fraction of step time — the committed form of the reference's
+``--profiling`` per-op printouts (conv_2d.cu:448-473).
+
+Usage (with the TPU attached):
+    python -m flexflow_tpu.tools.profile_report alexnet \
+        --batch-size 256 --out PROFILE_v5e.md
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("model", default="alexnet", nargs="?")
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--compute-dtype", default="bfloat16")
+    p.add_argument("--out", default="PROFILE_v5e.md")
+    args = p.parse_args(argv)
+
+    import jax
+
+    import flexflow_tpu as ff
+    from ..runtime.profiling import op_profile
+    from .offline_search import build_model
+
+    model = build_model(args.model, args.batch_size, 1)
+    model.config.compute_dtype = args.compute_dtype
+    model.compile(ff.SGDOptimizer(model, lr=0.001),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    prof = op_profile(model)
+
+    total = sum(v.get("forward_ms", 0) + v.get("backward_ms", 0)
+                for v in prof.values())
+    lines = [
+        f"# Per-op profile — {args.model}, batch {args.batch_size}, "
+        f"{args.compute_dtype}, {jax.default_backend()}",
+        "",
+        f"Standalone per-op timings (measure_compute_time analogue); the "
+        f"fused train step overlaps/fuses across ops, so the sum "
+        f"({total:.2f} ms) upper-bounds the real step.",
+        "",
+        "| op | fwd ms | bwd ms | GFLOP (fwd) | fwd TFLOPS | % of total |",
+        "|---|---|---|---|---|---|",
+    ]
+    for op in model.ops:
+        v = prof.get(op.name, {})
+        fwd = v.get("forward_ms", 0.0)
+        bwd = v.get("backward_ms", 0.0)
+        gflop = op.flops_per_sample() * op.output.dims[0] / 1e9
+        tf = (gflop / fwd) if fwd > 0 else 0.0
+        share = 100.0 * (fwd + bwd) / total if total else 0.0
+        lines.append(f"| {op.name} | {fwd:.3f} | {bwd:.3f} | {gflop:.2f} | "
+                     f"{tf:.1f} | {share:.1f}% |")
+    lines.append("")
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"profiled {len(prof)} ops ({total:.2f} ms standalone total) "
+          f"-> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,3 +82,29 @@ def test_lstm_in_search_space(devices):
         assert 16 % pc.dims[2] == 0     # hidden split divides H
         saw_hidden |= pc.dims[2] > 1
     assert saw_hidden
+
+
+def test_attention_in_search_space(devices):
+    """Attention proposals cover batch/seq/head-TP and legalize: the
+    head split divides num_heads, never straddling a head."""
+    import random
+
+    from flexflow_tpu.models.transformer import build_transformer
+    from flexflow_tpu.simulator.search import (random_parallel_config,
+                                               splittable_dims)
+
+    cfg = ff.FFConfig(batch_size=8)
+    m = ff.FFModel(cfg)
+    build_transformer(m, 8, seq_length=8, num_layers=1, embed_dim=32,
+                      num_heads=4, vocab_size=64)
+    attn = next(op for op in m.ops if op._type == "MultiHeadAttention")
+    assert splittable_dims(attn) == (0, 1, 2)
+    rng = random.Random(1)
+    saw_seq = saw_tp = False
+    for _ in range(80):
+        pc = attn.legalize_pc(random_parallel_config(attn, 8, rng))
+        assert 4 % pc.dims[2] == 0, pc          # head-aligned TP
+        assert 8 % max(1, pc.dims[1]) == 0      # seq split divides S
+        saw_seq |= pc.dims[1] > 1
+        saw_tp |= pc.dims[2] > 1
+    assert saw_seq and saw_tp
