@@ -127,6 +127,9 @@ class FFConfig:
     search_budget: int = 0
     search_alpha: float = 0.05
     search_overlap_backward_update: bool = False
+    # Also search pipeline stage assignments during compile() and apply
+    # the plan when it beats the best dim strategy (set_pipeline).
+    search_pipeline: bool = False
     dataset_path: str = ""
     import_strategy_file: str = ""
     # Set when importing a file produced by the reference implementation,
@@ -226,6 +229,8 @@ class FFConfig:
                 self.fused_optimizer = True
             elif a == "--zero-optimizer":
                 self.zero_optimizer = True
+            elif a == "--search-pipeline":
+                self.search_pipeline = True
             else:
                 rest.append(a)
             i += 1

@@ -611,6 +611,33 @@ class FFModel:
                                    alpha=cfg.search_alpha, machine_model=mm)
             cfg.strategies.update(best)
 
+            # Stage-assignment search (--search-pipeline): when a GPipe
+            # plan beats the best dim strategy AND the user hasn't placed
+            # stages by hand, apply it — operator placement discovered by
+            # the search, not just by the user (the reference's searched
+            # space and placement are one mechanism, mapper.cc:33-146).
+            if (cfg.search_pipeline
+                    and getattr(self, "_pipeline_req", None) is None):
+                from .simulator.cost_model import CostModel
+                from .simulator.pipeline_search import search_pipeline
+                from .simulator.simulator import Simulator
+
+                sim = Simulator(mm, CostModel(
+                    mm, measure=False, compute_dtype=cfg.compute_dtype))
+                dims_t = sim.simulate_runtime(self, dict(best))
+                plan = search_pipeline(self, machine_model=mm)
+                if plan is not None and plan["simulated_s"] < dims_t:
+                    print(f"flexflow_tpu: search selected a pipeline plan "
+                          f"({plan['num_stages']} stages x "
+                          f"dp{plan['dp_degree']}, "
+                          f"M={plan['num_microbatches']}): "
+                          f"{plan['simulated_s'] * 1e3:.3f} ms vs "
+                          f"{dims_t * 1e3:.3f} ms for the dim strategy")
+                    self.set_pipeline(
+                        num_stages=plan["num_stages"],
+                        dp_degree=plan["dp_degree"],
+                        num_microbatches=plan["num_microbatches"])
+
         # Per-op partition configs (default: data parallel over all devices,
         # reference model.cc:391-401 + strategy.cc:28-85 fallback).
         nd = self.machine.num_devices

@@ -103,3 +103,31 @@ def test_suggest_covers_both_spaces(devices):
         assert out["pipeline"]["num_stages"] >= 2
     else:
         assert "strategies" in out and out["simulated_s"] == alts["dims_s"]
+
+
+def test_compile_applies_searched_pipeline(devices):
+    """--search-pipeline: compile() adopts the pipeline plan when it
+    beats the dim strategy, and one train step runs under it."""
+    cfg = ff.FFConfig(batch_size=32, workers_per_node=8, search_budget=200,
+                      search_pipeline=True)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((32, 64), nchw=False)
+    t = inp
+    for i in range(6):
+        t = m.dense(t, 64, activation="relu", name=f"fc{i}")
+    t = m.dense(t, 10, name="head")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.05), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=1)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 64), dtype=np.float32)
+    y = rng.integers(0, 10, size=(32, 1), dtype=np.int32)
+    m.set_batch({inp: x}, y)
+    m.train_iteration()
+    m.sync()
+    # either the search adopted a pipeline plan (and it executed), or it
+    # measurably preferred the dim strategy — both must leave a runnable
+    # model; assert the pipeline path at least when adopted
+    if m._pipeline_plan is not None:
+        assert m._pipe_pack() is not None
