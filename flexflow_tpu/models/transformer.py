@@ -19,7 +19,8 @@ from ..ops.embedding import AggrMode
 def build_transformer(ff: FFModel, batch_size: int, seq_length: int = 256,
                       num_layers: int = 4, embed_dim: int = 512,
                       num_heads: int = 8, mlp_ratio: int = 4,
-                      vocab_size: int = 32000, dropout: float = 0.0):
+                      vocab_size: int = 32000, dropout: float = 0.0,
+                      moe_every: int = 0, num_experts: int = 8):
     """Returns (tokens_tensor, positions_tensor, softmax_output).
 
     tokens/positions: (B, S) int32 — positions are 0..S-1 per row (the
@@ -43,9 +44,16 @@ def build_transformer(ff: FFModel, batch_size: int, seq_length: int = 256,
                                    dropout=dropout, name=f"attn_{i}")
         x = ff.add(x, h, name=f"res_attn_{i}")
         h = ff.layer_norm(x, name=f"ln2_{i}")
-        h = ff.dense(h, embed_dim * mlp_ratio, activation="gelu",
-                     name=f"mlp_up_{i}")
-        h = ff.dense(h, embed_dim, name=f"mlp_down_{i}")
+        if moe_every and (i + 1) % moe_every == 0:
+            # MoE block (Switch): expert-parallel FFN in place of the
+            # dense MLP; dropped tokens ride the residual
+            h = ff.expert_mlp(h, num_experts=num_experts,
+                              hidden_size=embed_dim * mlp_ratio,
+                              activation="gelu", name=f"moe_{i}")
+        else:
+            h = ff.dense(h, embed_dim * mlp_ratio, activation="gelu",
+                         name=f"mlp_up_{i}")
+            h = ff.dense(h, embed_dim, name=f"mlp_down_{i}")
         x = ff.add(x, h, name=f"res_mlp_{i}")
 
     x = ff.layer_norm(x, name="ln_f")

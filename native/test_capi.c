@@ -234,6 +234,26 @@ int main(void) {
   assert(flexflow_tensor_detach_raw_ptr(m3, in3) == 0);
   flexflow_single_dataloader_destroy(sdl);
 
+  /* MoE layer through the C surface */
+  {
+    flexflow_config_t mc = flexflow_config_create(8, 1, 0);
+    flexflow_model_t mm = flexflow_model_create(mc);
+    int md[2] = {8, 16};
+    flexflow_tensor_t mi = flexflow_tensor_create(mm, 2, md, "float32");
+    flexflow_tensor_t mo =
+        flexflow_model_add_expert_mlp(mm, mi, 4, 32, 1.25, "moe");
+    assert(mo.impl != NULL);
+    flexflow_tensor_t mh = flexflow_model_add_dense(mm, mo, 4, 0, 1, "h");
+    mh = flexflow_model_add_softmax(mm, mh, NULL);
+    const char* mmet[] = {"accuracy"};
+    assert(flexflow_model_compile(mm, "sgd", 0.1,
+                                  "sparse_categorical_crossentropy", mmet,
+                                  1) == 0);
+    assert(flexflow_model_init_layers(mm) == 0);
+    flexflow_model_destroy(mm);
+    flexflow_config_destroy(mc);
+  }
+
   /* adam object + net config */
   flexflow_adam_optimizer_t adam =
       flexflow_adam_optimizer_create(m3, 0.001, 0.9, 0.999, 0.0, 1e-8);

@@ -76,3 +76,12 @@ def test_transformer_trains(devices):
         losses.append(m.last_loss)
         m.reset_metrics()
     assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_transformer_4d_example(devices):
+    """dp x sp x tp x ep in one graph (examples/transformer_4d.py)."""
+    from examples.transformer_4d import top_level_task
+
+    tokens_s = top_level_task([], seq=16, layers=2, dim=32, heads=4,
+                              vocab=64, iters=2)
+    assert tokens_s > 0
