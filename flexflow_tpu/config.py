@@ -130,6 +130,10 @@ class FFConfig:
     # Also search pipeline stage assignments during compile() and apply
     # the plan when it beats the best dim strategy (set_pipeline).
     search_pipeline: bool = False
+    # Gradient accumulation: split each staged batch into K micro-batches
+    # inside the jitted step (lax.scan; one micro's activations live at a
+    # time), average grads, apply the optimizer once.
+    grad_accum_steps: int = 1
     dataset_path: str = ""
     import_strategy_file: str = ""
     # Set when importing a file produced by the reference implementation,
@@ -231,6 +235,8 @@ class FFConfig:
                 self.zero_optimizer = True
             elif a == "--search-pipeline":
                 self.search_pipeline = True
+            elif a == "--grad-accum":
+                self.grad_accum_steps = int(take())
             else:
                 rest.append(a)
             i += 1

@@ -986,6 +986,18 @@ class FFModel:
 
         mkeys = self._metric_keys()
 
+        accum = max(1, int(self.config.grad_accum_steps))
+
+        def micro_metrics(loss, probs, labels):
+            msum = metrics.compute(probs, labels)
+            msum["loss"] = loss
+            msum["steps"] = 1.0
+            # On-device metric accumulation: one small vector rides along
+            # and is fetched once per drain — the analogue of the
+            # reference's future-chain metric fold (model.cc:1145-1167)
+            # without a host round-trip per step.
+            return jnp.stack([jnp.float32(msum.get(k, 0.0)) for k in mkeys])
+
         def step(params, stats, opt_state, hparams, batch, step_idx, macc):
             rng = jax.random.fold_in(base_key, step_idx)
             labels = batch["label"]
@@ -997,18 +1009,54 @@ class FFModel:
 
             (loss, (probs, new_stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            msum = metrics.compute(probs, labels)
-            msum["loss"] = loss
-            msum["steps"] = 1.0
-            # On-device metric accumulation: one small vector rides along
-            # and is fetched once per drain — the analogue of the
-            # reference's future-chain metric fold (model.cc:1145-1167)
-            # without a host round-trip per step.
-            mvec = jnp.stack([jnp.float32(msum.get(k, 0.0)) for k in mkeys])
+            mvec = micro_metrics(loss, probs, labels)
             new_params, new_opt = opt.apply(params, grads, opt_state, hparams)
             return new_params, new_stats, new_opt, macc + mvec
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 6))
+        def step_accum(params, stats, opt_state, hparams, batch, step_idx,
+                       macc):
+            # Gradient accumulation: K micro-batches through a lax.scan
+            # (one micro's activations live at a time), grads averaged,
+            # ONE optimizer apply — numerically the full-batch step for
+            # linear-in-loss grads (BatchNorm normalizes per micro, and
+            # dropout draws per-micro masks, as everywhere else).
+            rng = jax.random.fold_in(base_key, step_idx)
+            br = {k: v.reshape((accum, v.shape[0] // accum) + v.shape[1:])
+                  for k, v in batch.items()}
+            g0 = jax.tree.map(jnp.zeros_like, params)
+            m0 = jnp.zeros((len(mkeys),), jnp.float32)
+
+            def body(carry, idx):
+                g_acc, mv_acc, stats_c = carry
+                mb = {k: v[idx] for k, v in br.items()}
+                mlabels = mb["label"]
+
+                def loss_fn(p):
+                    env, new_stats = self._run_graph(
+                        p, stats_c, mb, True, jax.random.fold_in(rng, idx))
+                    loss = loss_fn_obj(env[loss_t.guid], mlabels)
+                    return loss, (env[probs_t.guid], new_stats)
+
+                (loss, (probs, new_stats)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                g_acc = jax.tree.map(lambda a, b: a + b / accum, g_acc, g)
+                return (g_acc, mv_acc + micro_metrics(loss, probs, mlabels),
+                        new_stats), None
+
+            (grads, mvec, new_stats), _ = jax.lax.scan(
+                body, (g0, m0, stats), jnp.arange(accum))
+            # per-STEP metric semantics: counts sum across micros; the
+            # loss entry is the mean micro loss; "steps" is one step
+            fix = jnp.ones((len(mkeys),), jnp.float32)
+            for name in ("loss", "steps"):
+                if name in mkeys:
+                    fix = fix.at[mkeys.index(name)].set(1.0 / accum)
+            mvec = mvec * fix
+            new_params, new_opt = opt.apply(params, grads, opt_state, hparams)
+            return new_params, new_stats, new_opt, macc + mvec
+
+        return jax.jit(step if accum == 1 else step_accum,
+                       donate_argnums=(0, 1, 2, 6))
 
     def _build_eval_step(self):
         loss_t = self._loss_input_tensor()
