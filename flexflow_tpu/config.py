@@ -134,6 +134,10 @@ class FFConfig:
     # inside the jitted step (lax.scan; one micro's activations live at a
     # time), average grads, apply the optimizer once.
     grad_accum_steps: int = 1
+    # Rematerialization: jax.checkpoint around weighted ops' forwards in
+    # the train step — recompute activations in backward instead of
+    # keeping them resident (FLOPs for HBM).
+    remat: bool = False
     dataset_path: str = ""
     import_strategy_file: str = ""
     # Set when importing a file produced by the reference implementation,
@@ -237,6 +241,8 @@ class FFConfig:
                 self.search_pipeline = True
             elif a == "--grad-accum":
                 self.grad_accum_steps = int(take())
+            elif a == "--remat":
+                self.remat = True
             else:
                 rest.append(a)
             i += 1

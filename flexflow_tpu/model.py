@@ -952,7 +952,17 @@ class FFModel:
             op = self.ops[i]
             xs = [env[t.guid] for t in op.inputs]
             pvals = params.get(op.param_key, {})
-            ys = op.forward(pvals, xs, ctx)
+            if training and self.config.remat and op.weights \
+                    and not op.init_stats():
+                # Rematerialization: drop this op's internal activations
+                # from the residual set and recompute them in backward —
+                # FLOPs for HBM, the standard TPU memory lever.  Stateful
+                # ops (running stats) stay un-remat'ed.
+                ys = jax.checkpoint(
+                    lambda p_, xs_, op_=op: op_.forward(p_, list(xs_), ctx)
+                )(pvals, tuple(xs))
+            else:
+                ys = op.forward(pvals, xs, ctx)
             if multi:
                 cpc = op.constraint_pc()
                 ys = [self.machine.constraint(y, cpc) for y in ys]

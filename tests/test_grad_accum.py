@@ -50,3 +50,32 @@ def test_grad_accum_metrics_count_all_samples(devices):
     m = _train(4, steps=2)
     pm = m.get_metrics()
     assert pm.train_all == 2 * 32  # every micro's samples counted
+
+def test_remat_matches_plain(devices):
+    """--remat: recompute-in-backward changes memory, not numerics."""
+    def run(remat):
+        cfg = ff.FFConfig(batch_size=16, remat=remat)
+        m = ff.FFModel(cfg)
+        inp = m.create_tensor((16, 3, 12, 12))
+        t = m.conv2d(inp, 8, 3, 3, 1, 1, 1, 1,
+                     activation=ff.ActiMode.RELU, name="conv1")
+        t = m.pool2d(t, 2, 2, 2, 2, 0, 0, name="pool1")
+        t = m.flat(t, name="flat")
+        t = m.dense(t, 10, name="fc")
+        m.softmax(t, name="sm")
+        m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"])
+        m.init_layers(seed=3)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((16, 12, 12, 3), dtype=np.float32)  # NHWC
+        y = rng.integers(0, 10, size=(16, 1), dtype=np.int32)
+        m.set_batch({inp: x}, y)
+        for _ in range(3):
+            m.train_iteration()
+        m.sync()
+        return m.get_parameter("conv1", "kernel"), m.get_parameter("fc", "kernel")
+
+    c_ref, f_ref = run(False)
+    c_r, f_r = run(True)
+    np.testing.assert_allclose(c_ref, c_r, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(f_ref, f_r, rtol=1e-6, atol=1e-7)
