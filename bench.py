@@ -88,6 +88,7 @@ def main():
     signal.signal(signal.SIGALRM, _timeout)
     signal.alarm(2400)
     extra = {}
+    sps_a = None  # partial results survive a mid-run hang
     try:
         import jax
 
@@ -124,12 +125,13 @@ def main():
             "vs_baseline": round(sps_a / PER_CHIP_BASELINE, 3),
             "extra": extra,
         }))
-    except Exception as e:  # never leave the driver without a line
+    except Exception as e:  # never leave the driver without a line —
+        # and keep any result measured before the failure
         print(json.dumps({
             "metric": "alexnet_train_samples_per_sec_per_chip",
-            "value": 0.0,
+            "value": round(sps_a, 2) if sps_a else 0.0,
             "unit": "samples/s/chip",
-            "vs_baseline": 0.0,
+            "vs_baseline": round(sps_a / PER_CHIP_BASELINE, 3) if sps_a else 0.0,
             "extra": extra,
             "error": f"{type(e).__name__}: {e}",
         }))
