@@ -29,7 +29,8 @@ class DataLoader:
     loaders)."""
 
     def __init__(self, ff, inputs: Dict[Tensor, np.ndarray],
-                 labels: np.ndarray, shuffle: bool = False, seed: int = 0):
+                 labels: np.ndarray, shuffle: bool = False, seed: int = 0,
+                 prefetch: bool = True):
         self.ff = ff
         self.inputs = {t: np.ascontiguousarray(self._to_native(t, a))
                        for t, a in inputs.items()}
@@ -43,6 +44,15 @@ class DataLoader:
         self._rng = np.random.default_rng(seed)
         self._order = np.arange(self.num_samples)
         self.next_index = 0
+        # Double buffering: the NEXT batch's host gather runs on a worker
+        # thread while the device computes the current step (the
+        # reference's scatter index-launch likewise overlaps with compute
+        # under Legion's dependence analysis).  device_put stays on the
+        # calling thread — only the numpy gather moves.
+        self.prefetch = prefetch
+        self._pool = None
+        self._pending = None   # (start_index, order_version, future)
+        self._order_version = 0
 
     @staticmethod
     def _to_native(t: Tensor, a: np.ndarray) -> np.ndarray:
@@ -77,18 +87,42 @@ class DataLoader:
         self.next_index = 0
         if self.shuffle:
             self._rng.shuffle(self._order)
+        self._order_version += 1   # invalidate any prefetched batch
+        self._pending = None
 
     def num_batches(self) -> int:
         return self.num_samples // self.batch_size
 
-    def next_batch(self, ff=None) -> None:
-        ff = ff or self.ff
-        b = self.batch_size
-        if self.next_index + b > self.num_samples:
-            self.next_index = 0
-        sel = self._order[self.next_index:self.next_index + b]
-        self.next_index += b
+    def _start_of(self, index: int) -> int:
+        return 0 if index + self.batch_size > self.num_samples else index
+
+    def _gather(self, start: int):
         from ..utils.native import gather_rows
 
-        ff.set_batch({t: gather_rows(a, sel) for t, a in self.inputs.items()},
-                     gather_rows(self.labels, sel))
+        sel = self._order[start:start + self.batch_size]
+        return ({t: gather_rows(a, sel) for t, a in self.inputs.items()},
+                gather_rows(self.labels, sel))
+
+    def next_batch(self, ff=None) -> None:
+        ff = ff or self.ff
+        start = self._start_of(self.next_index)
+        batch = None
+        if self._pending is not None:
+            pstart, pver, fut = self._pending
+            self._pending = None
+            if pstart == start and pver == self._order_version:
+                batch = fut.result()
+        if batch is None:
+            batch = self._gather(start)
+        self.next_index = start + self.batch_size
+        if self.prefetch:
+            if self._pool is None:
+                import concurrent.futures as cf
+
+                self._pool = cf.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ff-dataloader")
+            nxt = self._start_of(self.next_index)
+            self._pending = (nxt, self._order_version,
+                             self._pool.submit(self._gather, nxt))
+        xs, ys = batch
+        ff.set_batch(xs, ys)
