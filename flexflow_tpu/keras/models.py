@@ -161,6 +161,32 @@ class BaseModel:
             total.update({k: v for k, v in one.items() if k != "loss"})
         return self._logs_from(total)
 
+    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        """Per-sample final-layer outputs (probabilities), batched
+        through the eval step; trailing samples that don't fill a batch
+        are padded and trimmed."""
+        ff = self._ffmodel
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        arrs = [np.asarray(a) for a in xs]
+        n = arrs[0].shape[0]
+        b = ff.config.batch_size
+        outs = []
+        for lo in range(0, n, b):
+            chunk = [a[lo:lo + b] for a in arrs]
+            pad = b - chunk[0].shape[0]
+            if pad:
+                chunk = [np.concatenate([c, np.repeat(c[-1:], pad, axis=0)])
+                         for c in chunk]
+            ldims = tuple(ff.label_tensor.dims[1:])
+            dummy = np.zeros((b,) + ldims,
+                             np.int32 if "int" in ff.label_tensor.dtype
+                             else np.float32)
+            ff.set_batch({t: c for t, c in zip(self._core_inputs, chunk)},
+                         dummy)
+            probs = ff.predict_batch()
+            outs.append(probs[:b - pad])
+        return np.concatenate(outs, axis=0)
+
     def _logs_from(self, pm) -> Dict[str, float]:
         n = max(1, pm.train_all)
         return {

@@ -1161,6 +1161,14 @@ class FFModel:
                                      self._stats, self._batch)
         return {k: float(v) for k, v in msum.items()}
 
+    def predict_batch(self) -> np.ndarray:
+        """Final-op outputs (probabilities) for the staged batch."""
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        _, probs = self._eval_step_fn(self._offload_put(self._params, False),
+                                      self._stats, self._batch)
+        return np.asarray(probs)
+
     # ------------------------------------------------------------------
     # metrics (reference: UPDATE_METRICS_TASK fold, model.cc:1145-1167)
     # ------------------------------------------------------------------
