@@ -77,8 +77,42 @@ def run_one(name, batch_size=256, compute_dtype="bfloat16", steps=24,
     return sps, tflops, tflops * 1e12 / PEAK_FLOPS
 
 
+def sweep(out="BENCH_SWEEP.md"):
+    """Batch-size x dtype sweep (manual mode: `python bench.py --sweep`).
+    Writes the markdown table the single-number bench can't carry."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/flexflow_tpu_jax_cache")
+    lines = [f"# Throughput sweep — {jax.devices()[0].device_kind}",
+             "",
+             "| model | dtype | batch/chip | samples/s/chip | MFU |",
+             "|---|---|---|---|---|"]
+    for name in ("alexnet", "inception_v3"):
+        for dtype in ("bfloat16", "float32"):
+            for bs in (64, 128, 256, 512):
+                if name == "inception_v3" and bs > 128:
+                    continue  # HBM headroom
+                try:
+                    sps, _, mfu = run_one(name, batch_size=bs,
+                                          compute_dtype=dtype, steps=8)
+                    lines.append(f"| {name} | {dtype} | {bs} | "
+                                 f"{sps:.0f} | {mfu:.3f} |")
+                except Exception as e:
+                    lines.append(f"| {name} | {dtype} | {bs} | "
+                                 f"error: {type(e).__name__} | |")
+                print(lines[-1], flush=True)
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"-> {out}")
+
+
 def main():
     import signal
+
+    if "--sweep" in sys.argv:
+        sweep()
+        return
 
     def _timeout(signum, frame):
         raise TimeoutError("TPU backend unresponsive (tunnel wedged?)")
