@@ -108,3 +108,34 @@ def test_attention_in_search_space(devices):
         saw_seq |= pc.dims[1] > 1
         saw_tp |= pc.dims[2] > 1
     assert saw_seq and saw_tp
+
+
+def test_attention_head_tp_numerics(devices):
+    """Head-TP attention (config dim 2) == default placement."""
+    from flexflow_tpu.models.transformer import build_transformer
+
+    def run(strategies):
+        cfg = ff.FFConfig(batch_size=8, strategies=dict(strategies))
+        m = ff.FFModel(cfg)
+        tok, pos, _ = build_transformer(m, 8, seq_length=8, num_layers=1,
+                                        embed_dim=32, num_heads=4,
+                                        vocab_size=64)
+        m.compile(ff.SGDOptimizer(lr=0.05),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+        m.init_layers(seed=13)
+        rng = np.random.default_rng(2)
+        toks = rng.integers(0, 64, size=(8, 8)).astype(np.int32)
+        posa = np.broadcast_to(np.arange(8, dtype=np.int32), (8, 8)).copy()
+        m.set_batch({tok: toks, pos: posa},
+                    np.roll(toks, -1, axis=1).astype(np.int32))
+        for _ in range(3):
+            m.train_iteration()
+        m.sync()
+        return m.get_parameter("attn_0", "wq"), m
+
+    a0, _ = run({})
+    tp = {"attn_0": ff.ParallelConfig(dims=(2, 1, 4))}
+    a1, m = run(tp)
+    spec = m._params["attn_0"]["wq"].sharding.spec
+    assert len(spec) >= 2 and spec[1] is not None, spec
+    np.testing.assert_allclose(a0, a1, rtol=2e-4, atol=2e-5)
