@@ -67,6 +67,36 @@ class Flatten(_LayerSpec):
         return ff.flat(t, name=name)
 
 
+class AvgPool2d(_LayerSpec):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        k = kernel_size if isinstance(kernel_size, tuple) else (kernel_size,) * 2
+        s = stride if stride is not None else kernel_size
+        s = s if isinstance(s, tuple) else (s,) * 2
+        p = padding if isinstance(padding, tuple) else (padding,) * 2
+        self.kernel, self.stride, self.padding = k, s, p
+
+    def lower(self, ff, t, name):
+        return ff.pool2d(t, *self.kernel, *self.stride, *self.padding,
+                         pool_type="avg", name=name)
+
+
+class BatchNorm2d(_LayerSpec):
+    def __init__(self, num_features, eps=1e-5, momentum=0.1):
+        self.num_features = num_features
+        self.eps, self.momentum = eps, momentum
+
+    def lower(self, ff, t, name):
+        return ff.batch_norm(t, relu=False, name=name)
+
+
+class Dropout(_LayerSpec):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def lower(self, ff, t, name):
+        return ff.dropout(t, self.p, name=name)
+
+
 class ReLU(_LayerSpec):
     def lower(self, ff, t, name):
         return ff.relu(t, name=name)

@@ -147,3 +147,45 @@ def test_keras_predict(devices):
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-3)
     acc = float((np.argmax(probs, axis=1) == y).mean())
     assert acc > 0.7, acc
+
+
+def test_torch_frontend_extended_layers(devices):
+    """BatchNorm2d / Dropout / AvgPool2d lower and train."""
+    import numpy as np
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.torch_frontend import nn
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+            self.bn1 = nn.BatchNorm2d(8)
+            self.relu = nn.ReLU()
+            self.pool = nn.AvgPool2d(2, 2)
+            self.drop = nn.Dropout(0.1)
+            self.flat = nn.Flatten()
+            self.fc = nn.Linear(8 * 6 * 6, 4)
+            self.sm = nn.Softmax()
+
+        def forward(self, x):
+            x = self.pool(self.relu(self.bn1(self.conv1(x))))
+            return self.sm(self.fc(self.flat(self.drop(x))))
+
+    cfg = ff.FFConfig(batch_size=8)
+    net = Net()
+    model = net.build((8, 3, 12, 12), cfg)
+    inp = net._input_tensor
+    model.compile(ff.SGDOptimizer(lr=0.05), "sparse_categorical_crossentropy",
+                  ["accuracy"])
+    model.init_layers(seed=2)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 3, 12, 12), dtype=np.float32)
+    y = rng.integers(0, 4, size=(16, 1), dtype=np.int32)
+    dl = ff.DataLoader(model, {inp: x}, y)
+    for _ in range(3):
+        dl.next_batch(model)
+        model.train_iteration()
+    model.sync()
+    assert any(op._type == "BatchNorm" for op in model.ops)
+    assert any(op._type == "Dropout" for op in model.ops)
