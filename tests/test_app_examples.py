@@ -1,0 +1,37 @@
+"""Top-level application drivers as integration tests (reference: the
+cpp example apps ARE the test suite, SURVEY §4.1) — each runs its real
+CLI entry at a reduced size."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+
+def test_nmt_driver():
+    from examples.nmt import main
+
+    main(["-b", "8", "--seq", "6", "--hidden", "32", "--embed", "32",
+          "--vocab", "64", "--layers", "1", "--iters", "2"])
+
+
+def test_dlrm_driver():
+    from examples.dlrm import main
+
+    main(["-b", "16", "--arch-embedding-size", "64-64",
+          "--arch-sparse-feature-size", "16",
+          "--arch-mlp-bot", "8-16", "--arch-mlp-top", "32-16-1",
+          "--epochs", "1"])
+
+
+def test_pca_driver():
+    from examples.pca import main
+
+    main(["-b", "16"])
+
+
+def test_candle_uno_driver():
+    from examples.candle_uno import main
+
+    main(["-b", "8", "--epochs", "1"])
