@@ -75,6 +75,11 @@ def main(argv: Optional[List[str]] = None):
     best_rt = sim.simulate_runtime(model, best)
     speedup = dp_rt / best_rt if best_rt > 0 else float("inf")
 
+    # the OTHER searched space: GPipe stage assignment
+    from ..simulator.pipeline_search import search_pipeline
+
+    pipe_plan = search_pipeline(model, machine_model=mm)
+
     # provenance: how much of the final strategies' costs are measured
     prov_cost = CostModel(mm, measure=False,
                           compute_dtype=args.compute_dtype)
@@ -123,8 +128,17 @@ def main(argv: Optional[List[str]] = None):
         f"| data parallel ({args.devices}-way batch) | "
         f"{dp_rt * 1e3:.3f} ms | 1.00x |",
         f"| SOAP searched | {best_rt * 1e3:.3f} ms | {speedup:.2f}x |",
-        "",
     ]
+    if pipe_plan is not None:
+        lines.append(
+            f"| pipeline plan ({pipe_plan['num_stages']} stages x "
+            f"dp{pipe_plan['dp_degree']}, M={pipe_plan['num_microbatches']}) "
+            f"| {pipe_plan['simulated_s'] * 1e3:.3f} ms | "
+            f"{dp_rt / pipe_plan['simulated_s']:.2f}x |")
+    else:
+        lines.append("| pipeline plan | n/a (branching graph or no "
+                     "executable partition) | |")
+    lines.append("")
     if agree:
         lines += [
             "## Simulated-vs-measured agreement (single chip)",
