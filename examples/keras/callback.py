@@ -1,0 +1,58 @@
+"""Callback showcase: LearningRateScheduler + VerifyMetrics +
+EpochVerifyMetrics on a CIFAR-10 CNN
+(reference: examples/python/keras/callback.py)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras import backend as K
+from flexflow_tpu.keras.callbacks import (EpochVerifyMetrics,
+                                          LearningRateScheduler,
+                                          VerifyMetrics)
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import (Activation, Conv2D, Dense, Flatten, Input,
+                               MaxPooling2D, Model)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def lr_schedule(epoch: int) -> float:
+    return 0.01 if epoch == 0 else 0.02
+
+
+def top_level_task(num_samples=1024, epochs=4, batch_size=64):
+    print(K.backend())
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train[:num_samples].astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    inp = Input(shape=(3, 32, 32))
+    h = Conv2D(32, (3, 3), activation="relu", padding="same", name="conv1")(inp)
+    h = Conv2D(32, (3, 3), activation="relu", padding="same", name="conv2")(h)
+    h = MaxPooling2D((2, 2), name="pool1")(h)
+    h = Conv2D(64, (3, 3), activation="relu", padding="same", name="conv3")(h)
+    h = MaxPooling2D((2, 2), name="pool2")(h)
+    h = Flatten(name="flat")(h)
+    h = Dense(256, activation="relu", name="dense1")(h)
+    h = Dense(10, name="dense2")(h)
+    out = Activation("softmax", name="softmax")(h)
+    model = Model(inputs=[inp], outputs=out,
+                  config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[LearningRateScheduler(lr_schedule),
+                         VerifyMetrics(ModelAccuracy.CIFAR10_CNN),
+                         EpochVerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,110 @@
+"""CANDLE-UNO via the Keras functional API (reference:
+examples/python/keras/candle_uno/candle_uno.py + uno.py).
+
+Mirrors the reference topology: one feature-encoder sub-Model per
+cell/drug feature TYPE, shared (same layer weights) across all inputs
+of that type — drug1 and drug2 both pass through the one
+drug.descriptors/drug.fingerprints encoder pair (paired-drug
+configuration); scalar dose inputs pass through raw — then a concat
+and a dense trunk with a scalar regression head.
+
+The reference pulls the Uno pharmacogenomics tables from the CANDLE
+FTP server at run time (uno_data.py); this environment has no network
+egress, so the example trains on synthetic standard-normal feature
+rows with the real tower shapes and asserts the MSE decreases.
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras import Concatenate, Dense, Input, Model
+from flexflow_tpu.keras.optimizers import SGD
+
+FEATURE_SHAPES = {
+    "dose": 1,
+    "cell.rnaseq": 942,
+    "drug.descriptors": 5270,
+    "drug.fingerprints": 2048,
+}
+INPUT_FEATURES = {
+    "dose1": "dose",
+    "dose2": "dose",
+    "cell.rnaseq": "cell.rnaseq",
+    "drug1.descriptors": "drug.descriptors",
+    "drug1.fingerprints": "drug.fingerprints",
+    "drug2.descriptors": "drug.descriptors",
+    "drug2.fingerprints": "drug.fingerprints",
+}
+
+
+def build_feature_model(input_dim: int, name: str, dense_layers):
+    inp = Input(shape=(input_dim,))
+    h = inp
+    for i, width in enumerate(dense_layers):
+        h = Dense(width, activation="relu", name=f"{name}_d{i}")(h)
+    return Model(inp, h, name=name)
+
+
+def build_model(input_features, feature_shapes, dense_layers,
+                dense_feature_layers, batch_size: int) -> Model:
+    # One encoder per feature TYPE (reference uno.py build_feature_model),
+    # shared across every input of that type via nested model calls.
+    encoders = {}
+    for fea_type, shape in feature_shapes.items():
+        base = fea_type.split(".")[0]
+        if base in ("cell", "drug"):
+            encoders[fea_type] = build_feature_model(
+                shape, fea_type.replace(".", "_"), dense_feature_layers)
+
+    inputs, encoded = [], []
+    for name, fea_type in sorted(input_features.items()):
+        inp = Input(shape=(feature_shapes[fea_type],), name=name)
+        inputs.append(inp)
+        enc = encoders[fea_type](inp) if fea_type in encoders else inp
+        encoded.append(enc)
+
+    h = Concatenate(axis=1, name="concat")(encoded)
+    for i, width in enumerate(dense_layers):
+        h = Dense(width, activation="relu", name=f"trunk_d{i}")(h)
+    out = Dense(1, name="head")(h)
+    return Model(inputs, out, config=FFConfig(batch_size=batch_size))
+
+
+def synthetic_data(n, input_features, feature_shapes, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = [rng.standard_normal((n, feature_shapes[ft]), dtype=np.float32)
+          for _, ft in sorted(input_features.items())]
+    y = rng.standard_normal((n, 1), dtype=np.float32)
+    return xs, y
+
+
+def top_level_task(num_samples=512, epochs=2, batch_size=32,
+                   dense_layers=(1000, 1000, 1000),
+                   dense_feature_layers=(1000, 1000, 1000)):
+    model = build_model(INPUT_FEATURES, FEATURE_SHAPES, list(dense_layers),
+                        list(dense_feature_layers), batch_size)
+    model.compile(SGD(lr=0.001), "mean_squared_error",
+                  ["mean_squared_error"])
+    model.summary()
+    shared = [op for op in model.ffmodel.ops if op.share_from is not None]
+    assert shared, "paired-drug encoders should share weights"
+
+    xs, y = synthetic_data(num_samples, INPUT_FEATURES, FEATURE_SHAPES)
+    first = model.evaluate(xs, y)["mean_squared_error"]
+    model.fit(xs, y, epochs=epochs)
+    last = model.evaluate(xs, y)["mean_squared_error"]
+    print(f"uno MSE: {first:.4f} -> {last:.4f}")
+    assert last < first, f"MSE did not decrease: {first} -> {last}"
+    return model
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,62 @@
+"""Two functional sub-Models whose outputs are concatenated into a
+larger two-input model (reference:
+examples/python/keras/func_cifar10_cnn_concat_model.py — exercises
+Model.output composition and multi-input fit)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import (Concatenate, Conv2D, Dense, Flatten, Input,
+                               MaxPooling2D, Model)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def cnn_tower(postfix: str):
+    inp = Input(shape=(3, 32, 32), name=f"input{postfix}")
+    t = Conv2D(16, (3, 3), activation="relu", padding="same",
+               name=f"conv_0_{postfix}")(inp)
+    t = Conv2D(16, (3, 3), activation="relu", padding="same",
+               name=f"conv_1_{postfix}")(t)
+    return Model(inp, t, name=f"tower{postfix}")
+
+
+def top_level_task(num_samples=1024, epochs=4, batch_size=64):
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train[:num_samples].astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    model1 = cnn_tower("1")
+    model1.summary()
+    model2 = cnn_tower("2")
+    model2.summary()
+
+    h = Concatenate(axis=1, name="concat")([model1.output, model2.output])
+    h = MaxPooling2D((2, 2), name="pool1")(h)
+    h = Conv2D(64, (3, 3), activation="relu", padding="same", name="conv3")(h)
+    h = MaxPooling2D((2, 2), name="pool2")(h)
+    h = Flatten(name="flat")(h)
+    h = Dense(256, activation="relu", name="dense1")(h)
+    out = Dense(10, activation="softmax", name="dense2")(h)
+    model = Model([model1.input[0], model2.input[0]], out,
+                  config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.02), "sparse_categorical_crossentropy", ["accuracy"])
+    model.summary()
+    model.fit([x_train, x_train], y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+    return model
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn concat model")
+    top_level_task()

@@ -1,0 +1,61 @@
+"""Two Sequential towers (input inferred from the first layer's
+input_shape) concatenated into one functional model (reference:
+examples/python/keras/func_cifar10_cnn_concat_seq_model.py)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import (Concatenate, Conv2D, Dense, Flatten,
+                               MaxPooling2D, Model, Sequential)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def seq_tower(postfix: str) -> Sequential:
+    model = Sequential(name=f"tower{postfix}")
+    model.add(Conv2D(16, input_shape=(3, 32, 32), kernel_size=(3, 3),
+                     activation="relu", padding="same",
+                     name=f"conv_0_{postfix}"))
+    model.add(Conv2D(16, (3, 3), activation="relu", padding="same",
+                     name=f"conv_1_{postfix}"))
+    return model
+
+
+def top_level_task(num_samples=1024, epochs=4, batch_size=64):
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train[:num_samples].astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    model1 = seq_tower("1")
+    model1.summary()
+    model2 = seq_tower("2")
+    model2.summary()
+
+    h = Concatenate(axis=1, name="concat")([model1.output, model2.output])
+    h = MaxPooling2D((2, 2), name="pool1")(h)
+    h = Conv2D(64, (3, 3), activation="relu", padding="same", name="conv3")(h)
+    h = MaxPooling2D((2, 2), name="pool2")(h)
+    h = Flatten(name="flat")(h)
+    h = Dense(256, activation="relu", name="dense1")(h)
+    out = Dense(10, activation="softmax", name="dense2")(h)
+    model = Model([model1.input[0], model2.input[0]], out,
+                  config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.02), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit([x_train, x_train], y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+    return model
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn concat sequential model")
+    top_level_task()

@@ -1,0 +1,58 @@
+"""Nested models: two functional Models called as layers of a third
+(reference: examples/python/keras/func_cifar10_cnn_nested.py —
+``model(x)`` replays the sub-model's layer graph on a new input)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import (Conv2D, Dense, Flatten, Input,
+                               MaxPooling2D, Model)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task(num_samples=1024, epochs=4, batch_size=64):
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train[:num_samples].astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    # Front half: conv feature extractor.
+    in1 = Input(shape=(3, 32, 32))
+    t = Conv2D(16, (3, 3), activation="relu", padding="same", name="c1")(in1)
+    t = Conv2D(16, (3, 3), activation="relu", padding="same", name="c2")(t)
+    t = MaxPooling2D((2, 2), name="p1")(t)
+    model1 = Model(in1, t, name="features")
+
+    # Back half: conv + classifier head.
+    in2 = Input(shape=(16, 16, 16))
+    t = Conv2D(64, (3, 3), activation="relu", padding="same", name="c3")(in2)
+    t = MaxPooling2D((2, 2), name="p2")(t)
+    t = Flatten(name="flat")(t)
+    t = Dense(256, activation="relu", name="d1")(t)
+    t = Dense(10, activation="softmax", name="d2")(t)
+    model2 = Model(in2, t, name="head")
+
+    # Compose them by calling each model as a layer.
+    in3 = Input(shape=(3, 32, 32))
+    out = model2(model1(in3))
+    model = Model(in3, out, config=FFConfig(batch_size=batch_size))
+    model.compile(SGD(lr=0.02), "sparse_categorical_crossentropy", ["accuracy"])
+    model.summary()
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+    return model
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn nested")
+    top_level_task()

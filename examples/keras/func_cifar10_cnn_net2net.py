@@ -1,0 +1,104 @@
+"""Net2net widening: train a teacher CNN, build a student whose first
+conv is duplicated into two parallel towers, and seed the student's
+second conv with the teacher's kernel tiled along the input-channel
+axis (reference: examples/python/keras/func_cifar10_cnn_net2net.py).
+
+Kernels here are HWIO (kh, kw, cin, cout) — the widened student conv2
+takes 2×cin input channels, so the teacher kernel is concatenated on
+axis 2 (the reference's OIHW axis 1)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import (Concatenate, Conv2D, Dense, Flatten, Input,
+                               MaxPooling2D, Model)
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task(num_samples=1024, epochs=4, batch_size=64):
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train[:num_samples].astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    # Teacher.
+    c1 = Conv2D(16, (3, 3), activation="relu", padding="same", name="t_c1")
+    c2 = Conv2D(32, (3, 3), activation="relu", padding="same", name="t_c2")
+    d1 = Dense(256, activation="relu", name="t_d1")
+    d2 = Dense(10, activation="softmax", name="t_d2")
+
+    in1 = Input(shape=(3, 32, 32))
+    t = c1(in1)
+    t = c2(t)
+    t = MaxPooling2D((2, 2), name="t_p1")(t)
+    t = Flatten(name="t_flat")(t)
+    t = d1(t)
+    t = d2(t)
+    teacher = Model(in1, t, config=FFConfig(batch_size=batch_size))
+    teacher.compile(SGD(lr=0.02), "sparse_categorical_crossentropy",
+                    ["accuracy"])
+    teacher.fit(x_train, y_train, epochs=epochs,
+                callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+
+    c1_kernel, c1_bias = c1.get_weights(teacher.ffmodel)
+    c2_kernel, c2_bias = c2.get_weights(teacher.ffmodel)
+    d1_kernel, d1_bias = d1.get_weights(teacher.ffmodel)
+    d2_kernel, d2_bias = d2.get_weights(teacher.ffmodel)
+
+    # Widen conv2's input: the student concatenates two copies of the
+    # conv1 tower, so its conv2 kernel is the teacher's tiled on the
+    # input-channel (I) axis, halved to preserve the pre-activation sum.
+    c2_kernel_new = np.concatenate([c2_kernel, c2_kernel], axis=2) * 0.5
+
+    # Student: two parallel first convs, both seeded from teacher c1.
+    sc1_1 = Conv2D(16, (3, 3), activation="relu", padding="same", name="s_c1a")
+    sc1_2 = Conv2D(16, (3, 3), activation="relu", padding="same", name="s_c1b")
+    sc2 = Conv2D(32, (3, 3), activation="relu", padding="same", name="s_c2")
+    sd1 = Dense(256, activation="relu", name="s_d1")
+    sd2 = Dense(10, activation="softmax", name="s_d2")
+
+    in2 = Input(shape=(3, 32, 32))
+    t1 = sc1_1(in2)
+    t2 = sc1_2(in2)
+    t = Concatenate(axis=1, name="s_cat")([t1, t2])
+    t = sc2(t)
+    t = MaxPooling2D((2, 2), name="s_p1")(t)
+    t = Flatten(name="s_flat")(t)
+    t = sd1(t)
+    t = sd2(t)
+    student = Model(in2, t, config=FFConfig(batch_size=batch_size))
+    student.compile(SGD(lr=0.02), "sparse_categorical_crossentropy",
+                    ["accuracy"])
+
+    sc1_1.set_weights(student.ffmodel, c1_kernel, c1_bias)
+    sc1_2.set_weights(student.ffmodel, c1_kernel, c1_bias)
+    sc2.set_weights(student.ffmodel, c2_kernel_new, c2_bias)
+    sd1.set_weights(student.ffmodel, d1_kernel, d1_bias)
+    sd2.set_weights(student.ffmodel, d2_kernel, d2_bias)
+
+    # The widened student starts at teacher-level accuracy with NO
+    # training (function-preserving transform), then keeps training.
+    logs = student.evaluate(x_train, y_train)
+    acc = logs["accuracy"] * 100.0
+    print(f"student accuracy after net2net widening (no training): {acc:.2f}%")
+    assert acc >= ModelAccuracy.CIFAR10_CNN, \
+        f"net2net widening lost accuracy: {acc:.2f}%"
+
+    student.fit(x_train, y_train, epochs=max(1, epochs // 2),
+                callbacks=[VerifyMetrics(ModelAccuracy.CIFAR10_CNN)])
+    return student
+
+
+if __name__ == "__main__":
+    print("Functional API, cifar10 cnn teacher-student")
+    top_level_task()

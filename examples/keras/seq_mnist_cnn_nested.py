@@ -1,0 +1,57 @@
+"""A Sequential conv front-end and a functional Dense head composed
+into an outer Sequential via model-as-layer adds (reference:
+examples/python/keras/seq_mnist_cnn_nested.py)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import (Activation, Conv2D, Dense, Flatten, Input,
+                               MaxPooling2D, Model, Sequential)
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task(num_samples=2048, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 1, 28, 28)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    model1 = Sequential([
+        Conv2D(32, input_shape=(1, 28, 28), kernel_size=(3, 3),
+               padding="same", activation="relu", name="conv1"),
+        Conv2D(64, (3, 3), padding="same", activation="relu", name="conv2"),
+        MaxPooling2D((2, 2), name="pool1"),
+        Flatten(name="flat"),
+    ], name="conv_frontend")
+
+    inp = Input(shape=(12544,))
+    h = Dense(512, activation="relu", name="dense1")(inp)
+    h = Dense(10, name="dense2")(h)
+    out = Activation("softmax", name="softmax")(h)
+    model2 = Model(inp, out, name="dense_head")
+
+    model = Sequential(config=FFConfig(batch_size=batch_size))
+    model.add(model1)
+    model.add(model2)
+    model.summary()
+
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy", ["accuracy"])
+    model.fit(x_train, y_train, epochs=epochs,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_CNN)])
+    return model
+
+
+if __name__ == "__main__":
+    print("Sequential model, mnist cnn nested")
+    top_level_task()

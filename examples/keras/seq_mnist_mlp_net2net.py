@@ -1,0 +1,60 @@
+"""MLP net2net: teacher weights copied into a same-shape student via
+get_layer(index) + get/set_weights, student verified at teacher
+accuracy without training (reference:
+examples/python/keras/seq_mnist_mlp_net2net.py)."""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.optimizers import SGD
+from examples.keras.accuracy import ModelAccuracy
+from flexflow_tpu.keras import Activation, Dense, Input, Sequential
+from flexflow_tpu.keras.datasets import mnist
+
+
+def build_mlp(batch_size: int) -> Sequential:
+    model = Sequential(config=FFConfig(batch_size=batch_size))
+    model.add(Input(shape=(784,)))
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(10))
+    model.add(Activation("softmax"))
+    model.compile(SGD(lr=0.01), "sparse_categorical_crossentropy",
+                  ["accuracy"])
+    return model
+
+
+def top_level_task(num_samples=4096, epochs=2, batch_size=64):
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:num_samples].reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train[:num_samples].astype(np.int32)
+
+    teacher = build_mlp(batch_size)
+    teacher.fit(x_train, y_train, epochs=epochs,
+                callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP)])
+
+    student = build_mlp(batch_size)
+    for i in range(3):  # the three Dense layers hold all the weights
+        kernel, bias = teacher.get_layer(index=i).get_weights(teacher.ffmodel)
+        student.get_layer(index=i).set_weights(student.ffmodel, kernel, bias)
+
+    logs = student.evaluate(x_train, y_train)
+    acc = logs["accuracy"] * 100.0
+    print(f"student accuracy after weight transfer (no training): {acc:.2f}%")
+    assert acc >= ModelAccuracy.MNIST_MLP, \
+        f"net2net transfer lost accuracy: {acc:.2f}%"
+    return student
+
+
+if __name__ == "__main__":
+    print("Sequential model, mnist mlp net2net")
+    top_level_task()

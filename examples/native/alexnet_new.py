@@ -1,0 +1,83 @@
+"""AlexNet via the legacy v2 declare-then-wire API (reference:
+examples/python/native/alexnet_new.py — layers declared with
+``conv2d_v2``/``dense_v2`` first, then wired with ``init_inout``;
+its signature twist is the doubled first conv whose outputs concat).
+"""
+
+import sys
+
+try:
+    import flexflow_tpu  # noqa: F401  (pip-installed)
+except ImportError:  # source checkout without `pip install -e .`
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import time
+
+import flexflow_tpu as ff
+from flexflow_tpu.ops.conv2d import ActiMode
+
+
+def top_level_task(argv=None, iters=8):
+    cfg = ff.FFConfig()
+    cfg.parse_args(argv)
+    model = ff.FFModel(cfg)
+    inp = model.create_tensor((cfg.batch_size, 3, 229, 229), name="input")
+
+    conv1_1 = model.conv2d_v2("conv1_1", 3, 32, 11, 11, 4, 4, 2, 2,
+                              activation=ActiMode.RELU)
+    conv1_2 = model.conv2d_v2("conv1_2", 3, 32, 11, 11, 4, 4, 2, 2,
+                              activation=ActiMode.RELU)
+    pool1 = model.pool2d_v2("pool1", 3, 3, 2, 2, 0, 0)
+    conv2 = model.conv2d_v2("conv2", 64, 192, 5, 5, 1, 1, 2, 2,
+                            activation=ActiMode.RELU)
+    pool2 = model.pool2d_v2("pool2", 3, 3, 2, 2, 0, 0)
+    conv3 = model.conv2d_v2("conv3", 192, 384, 3, 3, 1, 1, 1, 1,
+                            activation=ActiMode.RELU)
+    conv4 = model.conv2d_v2("conv4", 384, 256, 3, 3, 1, 1, 1, 1,
+                            activation=ActiMode.RELU)
+    conv5 = model.conv2d_v2("conv5", 256, 256, 3, 3, 1, 1, 1, 1,
+                            activation=ActiMode.RELU)
+    pool3 = model.pool2d_v2("pool3", 3, 3, 2, 2, 0, 0)
+    flat = model.flat_v2("flat")
+    linear1 = model.dense_v2("linear1", 256 * 6 * 6, 4096,
+                             activation=ActiMode.RELU)
+    linear2 = model.dense_v2("linear2", 4096, 4096,
+                             activation=ActiMode.RELU)
+    linear3 = model.dense_v2("linear3", 4096, 10)
+
+    t1 = conv1_1.init_inout(model, inp)
+    t2 = conv1_2.init_inout(model, inp)
+    t = model.concat([t1, t2], 1, name="concat")
+    t = pool1.init_inout(model, t)
+    t = conv2.init_inout(model, t)
+    t = pool2.init_inout(model, t)
+    t = conv3.init_inout(model, t)
+    t = conv4.init_inout(model, t)
+    t = conv5.init_inout(model, t)
+    t = pool3.init_inout(model, t)
+    t = flat.init_inout(model, t)
+    t = linear1.init_inout(model, t)
+    t = linear2.init_inout(model, t)
+    t = linear3.init_inout(model, t)
+    t = model.softmax(t, name="softmax")
+
+    model.compile(ff.SGDOptimizer(model, lr=0.01),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    dl = ff.DataLoader.synthetic(model, inp, num_samples=cfg.batch_size)
+    model.init_layers()
+    dl.next_batch(model)
+    model.train_iteration()   # compile + warmup
+    model.sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        model.train_iteration()
+    model.sync()
+    dt = time.perf_counter() - t0
+    print(f"ELAPSED TIME = {dt:.4f}s, "
+          f"THROUGHPUT = {iters * cfg.batch_size / dt:.2f} samples/s")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,6 +1,6 @@
 """Keras-like frontend (reference: python/flexflow/keras/, 3894 LoC)."""
 
-from . import callbacks, datasets, layers, optimizers, preprocessing, utils
+from . import backend, callbacks, datasets, layers, optimizers, preprocessing, utils
 from .callbacks import (Callback, EpochVerifyMetrics, LearningRateScheduler,
                         VerifyMetrics)
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,

@@ -1,0 +1,15 @@
+"""Keras backend identification (reference: python/flexflow/keras/backend/
+— the reference reports its Legion backend; here the backend is JAX/XLA
+on TPU)."""
+
+_BACKEND = "flexflow_tpu"
+
+
+def backend() -> str:
+    return _BACKEND
+
+
+def image_data_format() -> str:
+    # layer specs are channels-first (C, H, W), matching the reference;
+    # the core converts to NHWC for the TPU convolutions internally
+    return "channels_first"

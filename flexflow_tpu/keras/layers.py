@@ -29,8 +29,11 @@ class KTensor:
         self.dtype = dtype
 
 
-def Input(shape: Sequence[int], dtype: str = "float32") -> KTensor:
-    return KTensor(tuple(shape), layer=None, inputs=(), dtype=dtype)
+def Input(shape: Sequence[int], dtype: str = "float32",
+          name: Optional[str] = None) -> KTensor:
+    t = KTensor(tuple(shape), layer=None, inputs=(), dtype=dtype)
+    t.name = name
+    return t
 
 
 def _pair(v) -> Tuple[int, int]:
@@ -41,6 +44,9 @@ def _pair(v) -> Tuple[int, int]:
 
 class Layer:
     _type = "Layer"
+    # shape hint from an ``input_shape=`` kwarg — lets a Sequential
+    # infer its Input() like the reference frontend does
+    _input_shape: Optional[Tuple[int, ...]] = None
 
     def __init__(self, name: Optional[str] = None):
         self.name = name or f"{self._type.lower()}_{next(_uid)}"
@@ -57,15 +63,45 @@ class Layer:
         """Build this layer onto the core FFModel; returns output Tensor."""
         raise NotImplementedError
 
+    def lower_into(self, ff, tensors, reuse_index: int = 0, share_op=None):
+        """Lower, handling repeated use of the same layer object in one
+        graph (classic keras weight sharing): later uses get a unique op
+        name and read the first use's weights via the core share_with
+        mechanism (reference: NMT SharedVariable, nmt/rnn.h:37-51)."""
+        if not reuse_index:
+            return self.lower(ff, tensors)
+        orig = self.name
+        self.name = f"{orig}~{reuse_index}"
+        try:
+            return self._lower_shared(ff, tensors, share_op)
+        finally:
+            self.name = orig
+
+    def _lower_shared(self, ff, tensors, share_op):
+        # default: parameterless layers just re-lower under the new name;
+        # layers with weights must override to share them
+        if share_op is not None and share_op.weights:
+            raise NotImplementedError(
+                f"{self._type} does not support weight-shared reuse")
+        return self.lower(ff, tensors)
+
     # Weight transfer between compiled models (reference: the keras
     # net2net examples built on Parameter::get/set_weights,
     # src/runtime/model.cu:260-370).  Arrays come back in _add_weight
     # order (kernel before bias).
+    def _weight_names(self, ffmodel):
+        # declaration order (kernel before bias) — the params pytree is a
+        # dict whose keys JAX sorts alphabetically, so read the op
+        for op in ffmodel.ops:
+            if op.param_key == self.name and op.weights:
+                return [w.name for w in op.weights]
+        return list(ffmodel._params[self.name])
+
     def get_weights(self, ffmodel):
         if self.name not in ffmodel._params:
             return ()  # parameterless layer (Flatten, pooling, ...)
         return tuple(ffmodel.get_parameter(self.name, w)
-                     for w in ffmodel._params[self.name])
+                     for w in self._weight_names(ffmodel))
 
     def set_weights(self, ffmodel, *arrays):
         if self.name not in ffmodel._params:
@@ -73,7 +109,7 @@ class Layer:
                 raise ValueError(f"layer {self.name} has no weights, "
                                  f"got {len(arrays)} arrays")
             return
-        names = list(ffmodel._params[self.name])
+        names = self._weight_names(ffmodel)
         if len(arrays) != len(names):
             raise ValueError(
                 f"layer {self.name} has weights {names}, got {len(arrays)} arrays")
@@ -89,6 +125,8 @@ class Conv2D(Layer):
                  use_bias: bool = True, name=None, **kw):
         super().__init__(name)
         self.filters = filters
+        if kw.get("input_shape"):
+            self._input_shape = tuple(kw["input_shape"])
         self.kernel = _pair(kernel_size)
         self.strides = _pair(strides)
         self.padding = padding
@@ -114,6 +152,13 @@ class Conv2D(Layer):
         return ff.conv2d(tensors[0], self.filters, *self.kernel, *self.strides,
                          ph, pw, activation=self.activation,
                          use_bias=self.use_bias, name=self.name)
+
+    def _lower_shared(self, ff, tensors, share_op):
+        ph, pw = self._pads()
+        return ff.conv2d(tensors[0], self.filters, *self.kernel, *self.strides,
+                         ph, pw, activation=self.activation,
+                         use_bias=self.use_bias, share_with=share_op,
+                         name=self.name)
 
 
 class _Pool2D(Layer):
@@ -173,6 +218,8 @@ class Dense(Layer):
                  use_bias: bool = True, name=None, **kw):
         super().__init__(name)
         self.units = units
+        if kw.get("input_shape"):
+            self._input_shape = tuple(kw["input_shape"])
         self.activation = activation or "none"
         self.use_bias = use_bias
 
@@ -183,6 +230,16 @@ class Dense(Layer):
         act = self.activation if self.activation != "softmax" else "none"
         t = ff.dense(tensors[0], self.units, activation=act,
                      use_bias=self.use_bias, name=self.name)
+        self._core_op = t.owner_op  # the weight owner, for shared reuse
+        if self.activation == "softmax":
+            t = ff.softmax(t, name=self.name + "_softmax")
+        return t
+
+    def _lower_shared(self, ff, tensors, share_op):
+        act = self.activation if self.activation != "softmax" else "none"
+        t = ff.dense(tensors[0], self.units, activation=act,
+                     use_bias=self.use_bias, share_with=share_op,
+                     name=self.name)
         if self.activation == "softmax":
             t = ff.softmax(t, name=self.name + "_softmax")
         return t
@@ -293,3 +350,10 @@ class Embedding(Layer):
 
         return ff.embedding(tensors[0], self.input_dim, self.output_dim,
                             aggr=AggrMode.SUM, name=self.name)
+
+    def _lower_shared(self, ff, tensors, share_op):
+        from ..ops.embedding import AggrMode
+
+        return ff.embedding(tensors[0], self.input_dim, self.output_dim,
+                            aggr=AggrMode.SUM, share_with=share_op,
+                            name=self.name)

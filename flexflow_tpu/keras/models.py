@@ -55,6 +55,7 @@ class BaseModel:
     def _lower(self):
         ff = FFModel(self._ffconfig)
         b = self._ffconfig.batch_size
+        self._core_inputs = []  # drop any previous compile's tensors
         mapping: Dict[int, object] = {}
         for kt in self._inputs:
             dims = (b,) + kt.shape
@@ -63,11 +64,22 @@ class BaseModel:
             mapping[id(kt)] = core
             self._core_inputs.append(core)
 
+        use_count: Dict[int, int] = {}
+        first_op: Dict[int, object] = {}
+
         def visit(kt: KTensor):
             if id(kt) in mapping:
                 return mapping[id(kt)]
             core_ins = [visit(i) for i in kt.inputs]
-            out = kt.layer.lower(ff, core_ins)
+            lid = id(kt.layer)
+            k = use_count.get(lid, 0)
+            out = kt.layer.lower_into(ff, core_ins, k, first_op.get(lid))
+            if k == 0:
+                # the weight-owning op (Dense+softmax returns the softmax
+                # tensor; the layer stashes its Linear as _core_op)
+                first_op[lid] = getattr(kt.layer, "_core_op", None) \
+                    or out.owner_op
+            use_count[lid] = k + 1
             mapping[id(kt)] = out
             return out
 
@@ -92,9 +104,54 @@ class BaseModel:
     def ffmodel(self) -> FFModel:
         return self._ffmodel
 
+    # -- model composition (reference: keras Model.input/.output, nested
+    # model calls in func_cifar10_cnn_nested.py, seq_mnist_cnn_nested.py) --
+    @property
+    def input(self) -> List[KTensor]:
+        self._ensure_graph()
+        return list(self._inputs)
+
+    @property
+    def output(self) -> KTensor:
+        self._ensure_graph()
+        return self._output
+
+    def _ensure_graph(self):
+        """Hook for subclasses that build their KTensor graph lazily."""
+
+    def __call__(self, x) -> KTensor:
+        """Use this (un-compiled) model as a layer: replay its layer graph
+        on new input tensor(s), reusing the same Layer objects."""
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        ins = self.input
+        if len(xs) != len(ins):
+            raise ValueError(
+                f"model {self.name} takes {len(ins)} inputs, got {len(xs)}")
+        memo = {id(old): new for old, new in zip(ins, xs)}
+
+        def rebuild(kt: KTensor) -> KTensor:
+            if id(kt) in memo:
+                return memo[id(kt)]
+            out = kt.layer([rebuild(i) for i in kt.inputs])
+            memo[id(kt)] = out
+            return out
+
+        return rebuild(self.output)
+
+    def get_layer(self, name: Optional[str] = None,
+                  index: Optional[int] = None) -> Layer:
+        layers = self.layers
+        if index is not None:
+            return layers[index]
+        for l in layers:
+            if l.name == name:
+                return l
+        raise ValueError(f"no layer named {name!r} in model {self.name}")
+
     @property
     def layers(self) -> List[Layer]:
         """Unique layers in graph order (reference: keras Model.layers)."""
+        self._ensure_graph()
         if self._output is None:
             return []
         ordered: List[Layer] = []
@@ -205,6 +262,9 @@ class BaseModel:
                 nparam = sum(w.volume() for w in op.weights)
                 lines.append(f"  {op.name:30s} {op._type:14s} "
                              f"out={op.output.dims} params={nparam}")
+        else:  # pre-compile: show the layer graph
+            for l in self.layers:
+                lines.append(f"  {l.name:30s} {l._type}")
         out = "\n".join(lines)
         print(out)
         return out
@@ -233,22 +293,43 @@ class Sequential(BaseModel):
             self.add(l)
 
     def add(self, layer_or_input):
+        """Append a Layer, an Input() tensor, or a whole (un-compiled)
+        model used as a layer (reference: seq_mnist_cnn_nested.py)."""
+        self._output = None  # graph is stale
         if isinstance(layer_or_input, KTensor):
             self._pending_input = layer_or_input
             return
         self._layer_list.append(layer_or_input)
 
-    def _build_graph(self, input_shape=None):
+    def _ensure_graph(self):
+        if self._output is not None:
+            return
+        self._build_graph()
+
+    def _infer_input(self) -> KTensor:
         from .layers import Input
 
-        if self._pending_input is None:
-            raise ValueError("Sequential needs an Input() added first")
-        t = self._pending_input
+        if self._pending_input is not None:
+            return self._pending_input
+        if not self._layer_list:
+            raise ValueError("Sequential has no layers")
+        first = self._layer_list[0]
+        if isinstance(first, BaseModel):
+            src = first.input[0]
+            return Input(src.shape, dtype=src.dtype)
+        if getattr(first, "_input_shape", None):
+            # reference convention: Conv2D/Dense(..., input_shape=...)
+            return Input(first._input_shape)
+        raise ValueError("Sequential needs an Input() or a first layer "
+                         "with input_shape=")
+
+    def _build_graph(self):
+        t = self._infer_input()
         self._inputs = [t]
         for l in self._layer_list:
             t = l(t)
         self._output = t
 
     def compile(self, optimizer, loss, metrics):
-        self._build_graph()
+        self._ensure_graph()
         super().compile(optimizer, loss, metrics)

@@ -53,6 +53,18 @@ from .parallel.strategy import load_strategies_from_file, save_strategies_to_fil
 from .tensor import DataType, Parameter, Tensor
 
 
+class LayerHandle:
+    """Deferred layer from the legacy v2 builder API (reference:
+    examples/python/native/alexnet_new.py — declare with *_v2, then
+    ``init_inout`` builds it onto the graph)."""
+
+    def __init__(self, build):
+        self._build = build
+
+    def init_inout(self, ffmodel: "FFModel", input_tensor: Tensor) -> Tensor:
+        return self._build(ffmodel, input_tensor)
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
@@ -131,11 +143,12 @@ class FFModel:
                padding_w: int, activation: str = ActiMode.NONE,
                use_bias: bool = True, groups: int = 1,
                kernel_initializer=None, bias_initializer=None,
-               name: Optional[str] = None) -> Tensor:
+               share_with=None, name: Optional[str] = None) -> Tensor:
         return self._append(Conv2D(self, input_tensor, out_channels, kernel_h,
                                    kernel_w, stride_h, stride_w, padding_h,
                                    padding_w, activation, use_bias, groups,
-                                   kernel_initializer, bias_initializer, name))
+                                   kernel_initializer, bias_initializer,
+                                   share_with, name))
 
     def pool2d(self, input_tensor: Tensor, kernel_h: int, kernel_w: int,
                stride_h: int, stride_w: int, padding_h: int, padding_w: int,
@@ -148,10 +161,10 @@ class FFModel:
     def dense(self, input_tensor: Tensor, out_dim: int,
               activation: str = ActiMode.NONE, use_bias: bool = True,
               kernel_initializer=None, bias_initializer=None,
-              name: Optional[str] = None) -> Tensor:
+              share_with=None, name: Optional[str] = None) -> Tensor:
         return self._append(Linear(self, input_tensor, out_dim, activation,
                                    use_bias, kernel_initializer,
-                                   bias_initializer, name))
+                                   bias_initializer, share_with, name))
 
     linear = dense
 
@@ -216,6 +229,35 @@ class FFModel:
     def batch_norm(self, input_tensor: Tensor, relu: bool = True,
                    name: Optional[str] = None) -> Tensor:
         return self._append(BatchNorm(self, input_tensor, relu, name))
+
+    # -- legacy "v2" declare-then-wire builders (reference:
+    # python/flexflow/core used by examples/python/native/alexnet_new.py:
+    # conv2d_v2(...) declares a layer handle, init_inout() wires it) -----
+    def conv2d_v2(self, name: str, in_channels: int, out_channels: int,
+                  kernel_h: int, kernel_w: int, stride_h: int, stride_w: int,
+                  padding_h: int, padding_w: int,
+                  activation: str = ActiMode.NONE,
+                  use_bias: bool = True) -> "LayerHandle":
+        return LayerHandle(lambda ff, t: ff.conv2d(
+            t, out_channels, kernel_h, kernel_w, stride_h, stride_w,
+            padding_h, padding_w, activation=activation, use_bias=use_bias,
+            name=name))
+
+    def pool2d_v2(self, name: str, kernel_h: int, kernel_w: int,
+                  stride_h: int, stride_w: int, padding_h: int,
+                  padding_w: int, pool_type: str = PoolType.MAX) -> "LayerHandle":
+        return LayerHandle(lambda ff, t: ff.pool2d(
+            t, kernel_h, kernel_w, stride_h, stride_w, padding_h, padding_w,
+            pool_type=pool_type, name=name))
+
+    def dense_v2(self, name: str, in_dim: int, out_dim: int,
+                 activation: str = ActiMode.NONE,
+                 use_bias: bool = True) -> "LayerHandle":
+        return LayerHandle(lambda ff, t: ff.dense(
+            t, out_dim, activation=activation, use_bias=use_bias, name=name))
+
+    def flat_v2(self, name: str) -> "LayerHandle":
+        return LayerHandle(lambda ff, t: ff.flat(t, name=name))
 
     def dropout(self, input_tensor: Tensor, rate: float, seed: int = 0,
                 name: Optional[str] = None) -> Tensor:

@@ -61,7 +61,7 @@ class Conv2D(Op):
                  padding_h: int, padding_w: int, activation: str = ActiMode.NONE,
                  use_bias: bool = True, groups: int = 1,
                  kernel_initializer=None, bias_initializer=None,
-                 name: Optional[str] = None):
+                 share_with=None, name: Optional[str] = None):
         super().__init__(model, [input_tensor], name)
         n, h, w, cin = input_tensor.dims
         self.kernel = (kernel_h, kernel_w)
@@ -73,6 +73,14 @@ class Conv2D(Op):
         out_h = 1 + (h + 2 * padding_h - kernel_h) // stride_h
         out_w = 1 + (w + 2 * padding_w - kernel_w) // stride_w
         self._add_output((n, out_h, out_w, out_channels), input_tensor.dtype)
+        if share_with is not None:
+            sw = share_with.share_from or share_with  # resolve chains
+            kshape = (kernel_h, kernel_w, cin // groups, out_channels)
+            if not isinstance(sw, Conv2D) or sw.use_bias != use_bias or \
+                    sw.weights[0].dims != kshape:
+                raise ValueError("share_with must be a Conv2D of identical shape")
+            self.share_from = sw
+            return
         # Kernel replicated across sample/spatial parts (the reference
         # replicates it and aggregates grad replicas, model.cc:763-787;
         # here GSPMD psums the gradient); out-channel dim shards with the

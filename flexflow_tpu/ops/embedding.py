@@ -52,6 +52,7 @@ class Embedding(Op):
         else:
             self._add_output((batch, out_dim), "float32")
         if share_with is not None:
+            share_with = share_with.share_from or share_with  # resolve chains
             if not isinstance(share_with, Embedding) or \
                     (share_with.num_entries, share_with.out_dim) != (num_entries, out_dim):
                 raise ValueError("share_with must be an Embedding of identical shape")

@@ -32,7 +32,7 @@ class Linear(Op):
     def __init__(self, model, input_tensor, out_dim: int,
                  activation: str = ActiMode.NONE, use_bias: bool = True,
                  kernel_initializer=None, bias_initializer=None,
-                 name: Optional[str] = None):
+                 share_with=None, name: Optional[str] = None):
         super().__init__(model, [input_tensor], name)
         in_dim = input_tensor.dims[-1]
         lead = input_tensor.dims[:-1]
@@ -40,6 +40,15 @@ class Linear(Op):
         self.use_bias = use_bias
         self._add_output(lead + (out_dim,), input_tensor.dtype)
         out_cfg_dim = len(lead + (out_dim,)) - 1  # channel dim of the output
+        if share_with is not None:
+            # resolve chains: sharing with an already-shared op means
+            # sharing with its owner
+            sw = share_with.share_from or share_with
+            if not isinstance(sw, Linear) or sw.use_bias != use_bias or \
+                    sw.weights[0].dims != (in_dim, out_dim):
+                raise ValueError("share_with must be a Dense of identical shape")
+            self.share_from = sw
+            return
         self._add_weight("kernel", (in_dim, out_dim),
                          kernel_initializer or DefaultWeightInitializer(),
                          partition_dims=(None, out_cfg_dim))

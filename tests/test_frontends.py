@@ -189,3 +189,129 @@ def test_torch_frontend_extended_layers(devices):
     model.sync()
     assert any(op._type == "BatchNorm" for op in model.ops)
     assert any(op._type == "Dropout" for op in model.ops)
+
+
+def test_keras_layer_reuse_shares_weights(devices):
+    """Calling the same Layer object twice in one graph shares its
+    weights (classic keras semantics; reference analogue: NMT
+    SharedVariable, nmt/rnn.h:37-51)."""
+    cfg = FFConfig(batch_size=8)
+    shared = keras.Dense(8, activation="relu", name="shared")
+    inp = keras.Input(shape=(8,))
+    h = shared(inp)
+    h = shared(h)            # second use of the SAME layer object
+    out = keras.Dense(4, activation="softmax", name="head")(h)
+    model = keras.Model(inp, out, config=cfg)
+    model.compile(keras.SGD(learning_rate=0.1),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+
+    core = model.ffmodel
+    reused = [op for op in core.ops if op.param_key == "shared"]
+    assert len(reused) == 2
+    assert reused[1].share_from is reused[0]
+    assert not reused[1].weights  # no weights of its own
+
+    # forward equals applying the one weight set twice
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 8), dtype=np.float32)
+    kernel = core.get_parameter("shared", "kernel")
+    bias = core.get_parameter("shared", "bias")
+    ref = np.maximum(x @ kernel + bias, 0.0)
+    ref = np.maximum(ref @ kernel + bias, 0.0)
+    probs = model.predict(x)
+    hk = core.get_parameter("head", "kernel")
+    hb = core.get_parameter("head", "bias")
+    logits = ref @ hk + hb
+    want = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want /= want.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(probs, want, rtol=2e-4, atol=2e-5)
+
+    # gradients flow through BOTH uses into the one parameter set
+    y = rng.integers(0, 4, size=(8, 1), dtype=np.int32)
+    core.set_batch({model._core_inputs[0]: x}, y)
+    core.train_iteration()
+    core.sync()
+    assert not np.allclose(core.get_parameter("shared", "kernel"), kernel)
+
+
+def test_keras_nested_model_composition(devices):
+    """model2(model1(x)) replays sub-model layer graphs into one core
+    graph (reference: func_cifar10_cnn_nested.py)."""
+    in1 = keras.Input(shape=(6,))
+    t = keras.Dense(12, activation="relu", name="f1")(in1)
+    feat = keras.Model(in1, t, name="feat")
+
+    in2 = keras.Input(shape=(12,))
+    t = keras.Dense(3, activation="softmax", name="h1")(in2)
+    head = keras.Model(in2, t, name="head")
+
+    in3 = keras.Input(shape=(6,))
+    model = keras.Model(in3, head(feat(in3)), config=FFConfig(batch_size=8))
+    model.compile(keras.SGD(learning_rate=0.2),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    assert model.get_layer("f1").name == "f1"
+    assert model.get_layer(index=0).name == "f1"
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((64, 6), dtype=np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    model.fit(x, y, epochs=10, verbose=False)
+    assert model.evaluate(x, y)["accuracy"] > 0.8
+
+
+def test_keras_sequential_input_shape_inference(devices):
+    """Sequential without an explicit Input infers it from the first
+    layer's input_shape (reference frontend convention)."""
+    model = keras.Sequential([
+        keras.Dense(16, input_shape=(8,), activation="relu"),
+        keras.Dense(2, activation="softmax"),
+    ], config=FFConfig(batch_size=8))
+    model.compile(keras.SGD(learning_rate=0.2),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    assert model.input[0].shape == (8,)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((32, 8), dtype=np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    model.fit(x, y, epochs=5, verbose=False)
+
+
+def test_keras_sequential_of_models(devices):
+    """Sequential.add(model) composes whole models as layers
+    (reference: seq_mnist_cnn_nested.py)."""
+    front = keras.Sequential([
+        keras.Dense(16, input_shape=(8,), activation="relu", name="fr1"),
+    ], name="front")
+    in2 = keras.Input(shape=(16,))
+    out2 = keras.Dense(2, activation="softmax", name="bk1")(in2)
+    back = keras.Model(in2, out2, name="back")
+
+    model = keras.Sequential(config=FFConfig(batch_size=8))
+    model.add(front)
+    model.add(back)
+    model.compile(keras.SGD(learning_rate=0.2),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    names = [op.name for op in model.ffmodel.ops]
+    assert any("fr1" in n for n in names) and any("bk1" in n for n in names)
+
+
+def test_keras_sequential_recompile_after_add(devices):
+    """add() after compile marks the graph stale; a second compile
+    rebuilds onto a fresh core model with fresh input tensors."""
+    model = keras.Sequential([
+        keras.Dense(8, input_shape=(4,), activation="relu"),
+        keras.Dense(2, activation="softmax"),
+    ], config=FFConfig(batch_size=8))
+    model.compile(keras.SGD(learning_rate=0.2),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    first_core = model.ffmodel
+
+    model.add(keras.Dense(2, activation="softmax"))
+    model.compile(keras.SGD(learning_rate=0.2),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    assert model.ffmodel is not first_core
+    assert len(model._core_inputs) == 1  # no stale input from compile #1
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((16, 4), dtype=np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    model.fit(x, y, epochs=2, verbose=False)

@@ -137,3 +137,79 @@ def test_unary_activations():
     from examples.keras.unary import top_level_task
 
     top_level_task(num_samples=512, epochs=4)
+
+
+def test_callback_lr_scheduler():
+    from examples.keras.callback import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_seq_mnist_cnn_nested():
+    from examples.keras.seq_mnist_cnn_nested import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_seq_mnist_mlp_net2net():
+    from examples.keras.seq_mnist_mlp_net2net import top_level_task
+
+    top_level_task(num_samples=1024, epochs=2)
+
+
+@pytest.mark.slow
+def test_func_cifar10_cnn_concat_model():
+    from examples.keras.func_cifar10_cnn_concat_model import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+@pytest.mark.slow
+def test_func_cifar10_cnn_concat_seq_model():
+    from examples.keras.func_cifar10_cnn_concat_seq_model import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+@pytest.mark.slow
+def test_func_cifar10_cnn_nested():
+    from examples.keras.func_cifar10_cnn_nested import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+@pytest.mark.slow
+def test_func_cifar10_cnn_net2net():
+    from examples.keras.func_cifar10_cnn_net2net import top_level_task
+
+    top_level_task(num_samples=512, epochs=4)
+
+
+def test_keras_candle_uno():
+    from examples.keras.candle_uno import top_level_task
+
+    # scaled-down towers, plus a second drug so the drug encoders are
+    # genuinely SHARED across two inputs of the same feature type
+    import examples.keras.candle_uno as mod
+
+    feature_shapes = {"dose": 1, "cell.rnaseq": 64,
+                      "drug.descriptors": 128, "drug.fingerprints": 96}
+    input_features = {"dose1": "dose", "dose2": "dose",
+                      "cell.rnaseq": "cell.rnaseq",
+                      "drug1.descriptors": "drug.descriptors",
+                      "drug1.fingerprints": "drug.fingerprints",
+                      "drug2.descriptors": "drug.descriptors",
+                      "drug2.fingerprints": "drug.fingerprints"}
+    model = mod.build_model(input_features, feature_shapes,
+                            [32] * 3, [32] * 3, batch_size=16)
+    from flexflow_tpu.keras.optimizers import SGD
+
+    model.compile(SGD(lr=0.001), "mean_squared_error",
+                  ["mean_squared_error"])
+    shared = [op for op in model.ffmodel.ops if op.share_from is not None]
+    assert shared, "drug encoders should share weights across drug1/drug2"
+    xs, y = mod.synthetic_data(128, input_features, feature_shapes)
+    first = model.evaluate(xs, y)["mean_squared_error"]
+    model.fit(xs, y, epochs=2)
+    last = model.evaluate(xs, y)["mean_squared_error"]
+    assert last < first

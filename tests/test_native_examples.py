@@ -70,3 +70,9 @@ def test_tensor_attach():
     from examples.native.tensor_attach import top_level_task
 
     assert top_level_task([])
+
+
+def test_alexnet_new_v2_api():
+    from examples.native.alexnet_new import top_level_task
+
+    top_level_task(["-b", "8"], iters=1)
