@@ -29,12 +29,11 @@ def top_level_task(argv=None):
                   ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                   [ff.MetricsType.ACCURACY])
     model.init_layers()
-    for i, op in enumerate(model.ops):
-        pc = model.get_strategies()[op.name]
-        print(f"layer[{i}] {op!r} pc={list(pc.dims)}")
+    model.print_layers()
+    for op in model.ops:
         for w in op.weights:
             arr = model.get_parameter(op.name, w.name)
-            print(f"   weight {w.name}: shape {arr.shape} "
+            print(f"   init {op.name}/{w.name}: shape {arr.shape} "
                   f"|mean| {np.abs(arr).mean():.4f}")
     assert len(model.ops) == 5
     return len(model.ops)

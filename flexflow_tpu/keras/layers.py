@@ -95,7 +95,7 @@ class Layer:
         for op in ffmodel.ops:
             if op.param_key == self.name and op.weights:
                 return [w.name for w in op.weights]
-        return list(ffmodel._params[self.name])
+        raise ValueError(f"no op owns the parameters of layer {self.name!r}")
 
     def get_weights(self, ffmodel):
         if self.name not in ffmodel._params:
@@ -148,10 +148,7 @@ class Conv2D(Layer):
         return (self.filters, oh, ow)
 
     def lower(self, ff, tensors):
-        ph, pw = self._pads()
-        return ff.conv2d(tensors[0], self.filters, *self.kernel, *self.strides,
-                         ph, pw, activation=self.activation,
-                         use_bias=self.use_bias, name=self.name)
+        return self._lower_shared(ff, tensors, None)
 
     def _lower_shared(self, ff, tensors, share_op):
         ph, pw = self._pads()
@@ -227,19 +224,15 @@ class Dense(Layer):
         return in_shapes[0][:-1] + (self.units,)
 
     def lower(self, ff, tensors):
-        act = self.activation if self.activation != "softmax" else "none"
-        t = ff.dense(tensors[0], self.units, activation=act,
-                     use_bias=self.use_bias, name=self.name)
-        self._core_op = t.owner_op  # the weight owner, for shared reuse
-        if self.activation == "softmax":
-            t = ff.softmax(t, name=self.name + "_softmax")
-        return t
+        return self._lower_shared(ff, tensors, None)
 
     def _lower_shared(self, ff, tensors, share_op):
         act = self.activation if self.activation != "softmax" else "none"
         t = ff.dense(tensors[0], self.units, activation=act,
                      use_bias=self.use_bias, share_with=share_op,
                      name=self.name)
+        if share_op is None:
+            self._core_op = t.owner_op  # the weight owner, for shared reuse
         if self.activation == "softmax":
             t = ff.softmax(t, name=self.name + "_softmax")
         return t
@@ -346,10 +339,7 @@ class Embedding(Layer):
         return (self.output_dim,) if len(s) <= 1 else s + (self.output_dim,)
 
     def lower(self, ff, tensors):
-        from ..ops.embedding import AggrMode
-
-        return ff.embedding(tensors[0], self.input_dim, self.output_dim,
-                            aggr=AggrMode.SUM, name=self.name)
+        return self._lower_shared(ff, tensors, None)
 
     def _lower_shared(self, ff, tensors, share_op):
         from ..ops.embedding import AggrMode

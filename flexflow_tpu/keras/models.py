@@ -60,7 +60,8 @@ class BaseModel:
         for kt in self._inputs:
             dims = (b,) + kt.shape
             nchw = len(dims) == 4
-            core = ff.create_tensor(dims, dtype=kt.dtype, nchw=nchw)
+            core = ff.create_tensor(dims, dtype=kt.dtype, nchw=nchw,
+                                    name=getattr(kt, "name", None) or "")
             mapping[id(kt)] = core
             self._core_inputs.append(core)
 
@@ -151,7 +152,10 @@ class BaseModel:
     @property
     def layers(self) -> List[Layer]:
         """Unique layers in graph order (reference: keras Model.layers)."""
-        self._ensure_graph()
+        try:
+            self._ensure_graph()
+        except ValueError:
+            return []  # introspection before the input is known
         if self._output is None:
             return []
         ordered: List[Layer] = []

@@ -238,10 +238,16 @@ class FFModel:
                   padding_h: int, padding_w: int,
                   activation: str = ActiMode.NONE,
                   use_bias: bool = True) -> "LayerHandle":
-        return LayerHandle(lambda ff, t: ff.conv2d(
-            t, out_channels, kernel_h, kernel_w, stride_h, stride_w,
-            padding_h, padding_w, activation=activation, use_bias=use_bias,
-            name=name))
+        def build(ff, t):
+            if t.dims[3] != in_channels:  # NHWC
+                raise ValueError(
+                    f"{name}: declared in_channels={in_channels}, "
+                    f"wired onto a {t.dims[3]}-channel tensor")
+            return ff.conv2d(t, out_channels, kernel_h, kernel_w, stride_h,
+                             stride_w, padding_h, padding_w,
+                             activation=activation, use_bias=use_bias,
+                             name=name)
+        return LayerHandle(build)
 
     def pool2d_v2(self, name: str, kernel_h: int, kernel_w: int,
                   stride_h: int, stride_w: int, padding_h: int,
@@ -253,8 +259,13 @@ class FFModel:
     def dense_v2(self, name: str, in_dim: int, out_dim: int,
                  activation: str = ActiMode.NONE,
                  use_bias: bool = True) -> "LayerHandle":
-        return LayerHandle(lambda ff, t: ff.dense(
-            t, out_dim, activation=activation, use_bias=use_bias, name=name))
+        def build(ff, t):
+            if t.dims[-1] != in_dim:
+                raise ValueError(f"{name}: declared in_dim={in_dim}, wired "
+                                 f"onto a {t.dims[-1]}-wide tensor")
+            return ff.dense(t, out_dim, activation=activation,
+                            use_bias=use_bias, name=name)
+        return LayerHandle(build)
 
     def flat_v2(self, name: str) -> "LayerHandle":
         return LayerHandle(lambda ff, t: ff.flat(t, name=name))
@@ -1270,6 +1281,18 @@ class FFModel:
         """Per-op fwd/bwd ms (reference --profiling printouts)."""
         from .runtime.profiling import print_op_profile
         print_op_profile(self)
+
+    def print_layers(self) -> None:
+        """Per-op metadata dump (reference: FFModel::print_layers,
+        src/runtime/model.cc — op type, output dims, weights, placement)."""
+        strategies = self.get_strategies() if self._compiled else {}
+        for i, op in enumerate(self.ops):
+            pc = strategies.get(op.name)
+            pcs = f" pc={list(pc.dims)}" if pc is not None else ""
+            print(f"layer[{i}] {op.name} ({op._type}) "
+                  f"out={op.output.dims}{pcs}")
+            for w in op.weights:
+                print(f"   weight {w.name}: {w.dims}")
 
     def _pack_entry(self, op_name: str, weight_name: str):
         pack = self._pipe_pack()

@@ -186,8 +186,6 @@ def test_func_cifar10_cnn_net2net():
 
 
 def test_keras_candle_uno():
-    from examples.keras.candle_uno import top_level_task
-
     # scaled-down towers, plus a second drug so the drug encoders are
     # genuinely SHARED across two inputs of the same feature type
     import examples.keras.candle_uno as mod
