@@ -39,6 +39,10 @@ _METRIC_NAMES = {
 }
 
 
+class _InputUnknown(ValueError):
+    """A Sequential's input tensor can't be inferred yet."""
+
+
 class BaseModel:
     def __init__(self, name: str = "model", config: Optional[FFConfig] = None):
         self.name = name
@@ -154,7 +158,7 @@ class BaseModel:
         """Unique layers in graph order (reference: keras Model.layers)."""
         try:
             self._ensure_graph()
-        except ValueError:
+        except _InputUnknown:
             return []  # introspection before the input is known
         if self._output is None:
             return []
@@ -316,7 +320,7 @@ class Sequential(BaseModel):
         if self._pending_input is not None:
             return self._pending_input
         if not self._layer_list:
-            raise ValueError("Sequential has no layers")
+            raise _InputUnknown("Sequential has no layers")
         first = self._layer_list[0]
         if isinstance(first, BaseModel):
             src = first.input[0]
@@ -324,8 +328,8 @@ class Sequential(BaseModel):
         if getattr(first, "_input_shape", None):
             # reference convention: Conv2D/Dense(..., input_shape=...)
             return Input(first._input_shape)
-        raise ValueError("Sequential needs an Input() or a first layer "
-                         "with input_shape=")
+        raise _InputUnknown("Sequential needs an Input() or a first layer "
+                            "with input_shape=")
 
     def _build_graph(self):
         t = self._infer_input()

@@ -157,7 +157,9 @@ def _replica_correct(y, mesh: Mesh, extra: Tuple[str, ...]):
 # group s executes ONLY stage s's subgraph — placement by branch, the
 # moral twin of the reference's placement by mapper.  Activations cross
 # stage boundaries as flattened buffers padded to the largest boundary
-# size so the ppermute ring keeps one static shape.
+# size so the ppermute ring keeps one static shape; the wire payload is
+# trimmed to the largest real inter-stage boundary and the unused wrap
+# hop is dropped (see ring_shift in gpipe_hetero_spmd).
 
 
 def _flat_pad(y: jax.Array, pad: int, dtype) -> jax.Array:
@@ -204,6 +206,25 @@ def gpipe_hetero_spmd(stage_fns: Sequence[Callable], params, x_local,
     branches = [make_branch(i) for i in range(P)]
 
     perm = [(i, (i + 1) % P) for i in range(P)]
+    # Boundary byte budget: the compute buffers pad to the largest
+    # boundary INCLUDING the stage-0 input and final output, but the only
+    # data that ever crosses the wire is an inter-stage boundary.  Trim
+    # the ppermute payload to the largest REAL hop (conv front stages
+    # feeding a small dense head make this much smaller than pad) and
+    # drop the unused wrap hop (P-1 -> 0; slot 0 reads the microbatch
+    # feed instead).  Kept as ONE collective — per-hop-sized ppermutes
+    # break shard_map's transpose sharding inference under jax.grad.
+    n_hop = [max(1, int(np.prod(sh)) if sh else 1) for sh in out_shapes]
+    n_wire = max(n_hop[:P - 1]) if P > 1 else pad
+    trim = P > 1 and n_wire < pad
+
+    def ring_shift(y):
+        if not trim:
+            return lax.ppermute(y, axis_name, perm)
+        r = lax.ppermute(y[:, :n_wire], axis_name,
+                         [(i, i + 1) for i in range(P - 1)])
+        return jnp.pad(r, ((0, 0), (0, pad - n_wire)))
+
     T = M + P - 1
     carry0 = jnp.zeros((mb, pad), dtype)
     outbuf0 = jnp.zeros((M, mb, pad), dtype)
@@ -221,7 +242,7 @@ def gpipe_hetero_spmd(stage_fns: Sequence[Callable], params, x_local,
         prev = lax.dynamic_index_in_dim(outbuf, widx, 0, keepdims=False)
         bank = jnp.where(jnp.logical_and(s == P - 1, t >= P - 1), y, prev)
         outbuf = lax.dynamic_update_index_in_dim(outbuf, bank, widx, 0)
-        return (lax.ppermute(y, axis_name, perm), outbuf), None
+        return (ring_shift(y), outbuf), None
 
     (_, outbuf), _ = lax.scan(tick, (carry0, outbuf0), jnp.arange(T))
     mask = (s == P - 1).astype(jnp.float32)
