@@ -27,20 +27,71 @@ import jax
 import numpy as np
 
 
+def _unpack_tree(model, tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Canonicalize a params-shaped tree: expand a pipelined model's
+    packed ``_pipe`` stage-weight buffer into per-op arrays so
+    checkpoints are layout-portable (pipeline <-> plain, different stage
+    splits, different meshes)."""
+    pack = model._pipe_pack() if hasattr(model, "_pipe_pack") else None
+    if not pack or "_pipe" not in tree:
+        return tree
+    buf = tree["_pipe"]["buffer"]  # device-side: multi-host shards stay put
+    out = {k: v for k, v in tree.items() if k != "_pipe"}
+    for opn, ws in pack["entries"].items():
+        d = dict(out.get(opn, {}))
+        for wn, e in ws.items():
+            d[wn] = model._pack_read(buf[e[0]], e)
+        out[opn] = d
+    return out
+
+
+def _repack_tree(model, canonical: Dict[str, Any], like: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of _unpack_tree: fold per-op arrays of packed ops back
+    into the model's ``_pipe`` buffer, placed with the LIKE leaf's
+    sharding (params vs ZeRO-sharded optimizer slots differ)."""
+    pack = model._pipe_pack() if hasattr(model, "_pipe_pack") else None
+    if not pack or not isinstance(like, dict) or "_pipe" not in like:
+        return canonical
+    import jax.numpy as jnp
+
+    like_buf = like["_pipe"]["buffer"]
+    buf = jnp.zeros(like_buf.shape, like_buf.dtype)
+    out = {}
+    for opn, ws in canonical.items():
+        entries = pack["entries"].get(opn)
+        if entries:
+            for wn, a in ws.items():
+                buf = model._pack_write(buf, entries[wn],
+                                        jnp.asarray(a, like_buf.dtype))
+        else:
+            out[opn] = ws
+    pipe = {k: v for k, v in like["_pipe"].items() if k != "buffer"}
+    pipe["buffer"] = jax.device_put(buf, like_buf.sharding)
+    out["_pipe"] = pipe
+    return out
+
+
 def _tree_from_model(model) -> Dict[str, Any]:
-    state = {"params": model._params, "stats": model._stats,
+    state = {"params": _unpack_tree(model, model._params),
+             "stats": model._stats,
              "step": np.full((), model._step_count, np.int64)}
     if model._opt_state is not None:
-        state["opt_state"] = model._opt_state
+        state["opt_state"] = {
+            k: (_unpack_tree(model, v) if isinstance(v, dict) else v)
+            for k, v in model._opt_state.items()}
     return state
 
 
 def _apply_tree(model, state: Dict[str, Any]) -> None:
-    model._params = state["params"]
+    model._params = _repack_tree(model, state["params"], model._params)
     model._stats = state.get("stats", model._stats)
     model._step_count = int(state.get("step", 0))
     if "opt_state" in state and state["opt_state"]:
-        model._opt_state = state["opt_state"]
+        cur = model._opt_state or {}
+        model._opt_state = {
+            k: (_repack_tree(model, v, cur.get(k))
+                if isinstance(v, dict) else v)
+            for k, v in state["opt_state"].items()}
 
 
 def save_checkpoint(model, path: str, force: bool = True) -> None:
@@ -153,6 +204,8 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
 
         step = model._step_count if step is None else step
+        if not self._mgr.should_save(step):
+            return False  # skip the tree build (and any pipe unpack)
         return self._mgr.save(step, args=ocp.args.StandardSave(
             _tree_from_model(model)))
 

@@ -111,3 +111,67 @@ def test_op_profile_reports_all_ops(devices):
                       fromlist=["op_profile"]).op_profile(m, which="forward")
     assert set(prof) == {op.name for op in m.ops}
     assert all(v["forward_ms"] >= 0 for v in prof.values())
+
+
+def test_pipeline_checkpoint_layout_portable(devices, tmp_path):
+    """Checkpoints canonicalize the packed pipeline stage-weight buffer
+    to per-op arrays, so a save from a pipelined model restores into a
+    plain model and vice versa (elastic resume across layout changes)."""
+    import flexflow_tpu as ff
+
+    def build(pipeline):
+        cfg = ff.FFConfig(batch_size=16)
+        m = ff.FFModel(cfg)
+        inp = m.create_tensor((16, 16), nchw=False, name="x")
+        t = m.dense(inp, 32, activation="relu", name="fc1")
+        t = m.dense(t, 24, activation="relu", name="fc2")
+        t = m.dense(t, 10, name="fc3")
+        m.softmax(t, name="sm")
+        if pipeline:
+            m.set_pipeline(num_stages=2, num_microbatches=4, dp_degree=2)
+        m.compile(ff.SGDOptimizer(lr=0.05, momentum=0.9),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+        m.init_layers(seed=3)
+        return m, inp
+
+    m, inp = build(True)
+    if m._pipeline_plan is None:
+        pytest.skip("pipeline not expressible on this mesh")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 16), dtype=np.float32)
+    y = rng.integers(0, 10, size=(16, 1), dtype=np.int32)
+    m.set_batch({inp: x}, y)
+    m.train_iteration()
+    m.sync()
+    k1 = m.get_parameter("fc2", "kernel")
+    p = str(tmp_path / "ckpt")
+    m.save(p)
+
+    # pipelined -> pipelined (packed buffer round-trips), resume trains
+    m2, inp2 = build(True)
+    m2.load(p)
+    np.testing.assert_allclose(k1, m2.get_parameter("fc2", "kernel"),
+                               rtol=1e-6)
+    m2.set_batch({inp2: x}, y)
+    m2.train_iteration()
+    m2.sync()
+
+    # pipelined -> plain (canonical per-op layout restores anywhere)
+    m3, inp3 = build(False)
+    m3.load(p)
+    np.testing.assert_allclose(k1, m3.get_parameter("fc2", "kernel"),
+                               rtol=1e-6)
+    m3.set_batch({inp3: x}, y)
+    m3.train_iteration()
+    m3.sync()
+
+    # plain -> pipelined (per-op arrays repack into the stage buffer)
+    p2 = str(tmp_path / "ckpt2")
+    m3.save(p2)
+    m4, inp4 = build(True)
+    m4.load(p2)
+    np.testing.assert_allclose(m3.get_parameter("fc1", "kernel"),
+                               m4.get_parameter("fc1", "kernel"), rtol=1e-6)
+    m4.set_batch({inp4: x}, y)
+    m4.train_iteration()
+    m4.sync()
