@@ -36,11 +36,15 @@ def _unpack_tree(model, tree: Dict[str, Any]) -> Dict[str, Any]:
     if not pack or "_pipe" not in tree:
         return tree
     buf = tree["_pipe"]["buffer"]  # device-side: multi-host shards stay put
+    rows = {}  # slice each ring row once, not once per weight
     out = {k: v for k, v in tree.items() if k != "_pipe"}
     for opn, ws in pack["entries"].items():
         d = dict(out.get(opn, {}))
         for wn, e in ws.items():
-            d[wn] = model._pack_read(buf[e[0]], e)
+            row = rows.get(e[0])
+            if row is None:
+                row = rows[e[0]] = buf[e[0]]
+            d[wn] = model._pack_read(row, e)
         out[opn] = d
     return out
 
@@ -52,17 +56,19 @@ def _repack_tree(model, canonical: Dict[str, Any], like: Dict[str, Any]) -> Dict
     pack = model._pipe_pack() if hasattr(model, "_pipe_pack") else None
     if not pack or not isinstance(like, dict) or "_pipe" not in like:
         return canonical
-    import jax.numpy as jnp
-
     like_buf = like["_pipe"]["buffer"]
-    buf = jnp.zeros(like_buf.shape, like_buf.dtype)
+    # Assemble on host (restored canonical leaves are host/replicated),
+    # then place with ONE transfer — per-weight .at[].set would copy the
+    # whole buffer once per weight.
+    buf = np.zeros(like_buf.shape,
+                   jax.dtypes.canonicalize_dtype(like_buf.dtype))
     out = {}
     for opn, ws in canonical.items():
         entries = pack["entries"].get(opn)
         if entries:
             for wn, a in ws.items():
-                buf = model._pack_write(buf, entries[wn],
-                                        jnp.asarray(a, like_buf.dtype))
+                slot, off, shape, n = entries[wn]
+                buf[slot, off:off + n] = np.asarray(a).reshape(-1)
         else:
             out[opn] = ws
     pipe = {k: v for k, v in like["_pipe"].items() if k != "buffer"}

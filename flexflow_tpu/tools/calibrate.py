@@ -173,10 +173,19 @@ def main(argv: Optional[List[str]] = None):
                    help="fitted machine params path (default: packaged "
                         "machine_v5e.json)")
     p.add_argument("--max-seconds", type=float, default=3600.0)
+    p.add_argument("--fit-only", action="store_true",
+                   help="skip measuring; refit the roofline from the "
+                        "TPU-tagged entries already in the cache (runs "
+                        "on any backend — e.g. after a tunnel drop cut "
+                        "a calibration run short)")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
     import jax
+
+    if args.fit_only:
+        # no measuring — don't init (or hang on) the TPU backend
+        jax.config.update("jax_platforms", "cpu")
 
     from ..simulator import cost_model as cm
     from ..simulator.machine import CALIBRATION_PATH, TPUMachineModel
@@ -184,15 +193,16 @@ def main(argv: Optional[List[str]] = None):
     out = args.out or cm.MEASURED_CACHE
     fit_out = args.fit_out or CALIBRATION_PATH
     platform = jax.default_backend()
-    if platform != "tpu":
+    if platform != "tpu" and not args.fit_only:
         print(f"[calibrate] WARNING: measuring on {platform!r}, not TPU — "
               "entries will be tagged accordingly and ignored by searches "
               "targeting TPU")
 
     mm = TPUMachineModel(num_devices=args.devices)
-    cost = cm.CostModel(mm, measure=True, cache_path=out,
+    cost = cm.CostModel(mm, measure=not args.fit_only, cache_path=out,
                         compute_dtype=args.compute_dtype,
-                        measured_cache_path=out, target_platform=platform)
+                        measured_cache_path=out,
+                        target_platform="tpu" if args.fit_only else platform)
 
     models, nds = [], []
     # AlexNet: full SOAP candidate space at the target machine size …
@@ -222,11 +232,16 @@ def main(argv: Optional[List[str]] = None):
 
     print(f"[calibrate] {len(jobs)} measurement jobs "
           f"(cache: {len(cost._measured)} entries pre-loaded)")
-    run_measurements(jobs, cost, args.max_seconds, verbose=not args.quiet)
+    if args.fit_only:
+        print("[calibrate] --fit-only: skipping measurement, refitting "
+              "from the cached TPU entries")
+    else:
+        run_measurements(jobs, cost, args.max_seconds,
+                         verbose=not args.quiet)
 
     recs = collect_fit_records(models, nds, cost)
     fit = fit_machine(recs, mm)
-    if fit and platform != "tpu" and args.fit_out is None:
+    if fit and platform != "tpu" and not args.fit_only and args.fit_out is None:
         # Never let a CPU-host dry run overwrite the packaged TPU fit —
         # TPUMachineModel.calibrated() has no platform filter of its own.
         print(f"[calibrate] NOT writing machine fit: measured on "
