@@ -491,6 +491,12 @@ class FFModel:
         r, off, _, n = entry
         return buf.at[r, off:off + n].set(value.reshape(-1))
 
+    @staticmethod
+    def _pack_write_host(np_buf, entry, value):
+        """In-place numpy twin of _pack_write (checkpoint assembly)."""
+        r, off, _, n = entry
+        np_buf[r, off:off + n] = np.asarray(value).reshape(-1)
+
     def _pipe_buffer_sharding(self) -> NamedSharding:
         plan = self._pipeline_plan
         groups = self.machine.axes_for_degrees(

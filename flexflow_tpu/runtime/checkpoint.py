@@ -57,22 +57,32 @@ def _repack_tree(model, canonical: Dict[str, Any], like: Dict[str, Any]) -> Dict
     if not pack or not isinstance(like, dict) or "_pipe" not in like:
         return canonical
     like_buf = like["_pipe"]["buffer"]
-    # Assemble on host (restored canonical leaves are host/replicated),
-    # then place with ONE transfer — per-weight .at[].set would copy the
-    # whole buffer once per weight.
-    buf = np.zeros(like_buf.shape,
-                   jax.dtypes.canonicalize_dtype(like_buf.dtype))
-    out = {}
-    for opn, ws in canonical.items():
-        entries = pack["entries"].get(opn)
-        if entries:
-            for wn, a in ws.items():
-                slot, off, shape, n = entries[wn]
-                buf[slot, off:off + n] = np.asarray(a).reshape(-1)
-        else:
-            out[opn] = ws
+    packed = [(entries[wn], a)
+              for opn, ws in canonical.items()
+              if (entries := pack["entries"].get(opn))
+              for wn, a in ws.items()]
+    out = {opn: ws for opn, ws in canonical.items()
+           if opn not in pack["entries"]}
     pipe = {k: v for k, v in like["_pipe"].items() if k != "buffer"}
-    pipe["buffer"] = jax.device_put(buf, like_buf.sharding)
+    if all(getattr(a, "is_fully_addressable", True) for _, a in packed):
+        # Assemble on host, place with ONE transfer — per-weight
+        # .at[].set would copy the whole buffer once per weight.
+        buf = np.zeros(like_buf.shape,
+                       jax.dtypes.canonicalize_dtype(like_buf.dtype))
+        for entry, a in packed:
+            type(model)._pack_write_host(buf, entry, a)
+        pipe["buffer"] = jax.device_put(buf, like_buf.sharding)
+    else:
+        # Multi-host restore hands back sharded device arrays a host
+        # can't materialize — stay on device (slower: one buffer copy
+        # per weight).
+        import jax.numpy as jnp
+
+        buf = jnp.zeros(like_buf.shape, like_buf.dtype)
+        for entry, a in packed:
+            buf = type(model)._pack_write(buf, entry,
+                                          jnp.asarray(a, like_buf.dtype))
+        pipe["buffer"] = jax.device_put(buf, like_buf.sharding)
     out["_pipe"] = pipe
     return out
 

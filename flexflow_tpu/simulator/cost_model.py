@@ -231,12 +231,17 @@ class CostModel:
                 while True:
                     diff = run(n) - base
                     if diff >= 0.05 or n >= 4096:
-                        # a latency spike in the baseline can push diff
-                        # negative at the cap — never persist that
+                        # spike guards, both directions: confirm with a
+                        # second sample (min cancels a spiked numerator);
+                        # a spiked BASELINE pushes diff negative — never
+                        # persist that
+                        diff = min(diff, run(n) - base)
                         return diff / (n - 4) if diff > 0 else None
                     n *= 4
 
             return attempt() or attempt()  # one retry on a bad baseline
+        except TimeoutError:
+            raise  # calibrate's wedge watchdog must see its own alarm
         except Exception as e:
             if os.environ.get("FF_COSTMODEL_DEBUG"):
                 print(f"[cost_model] measure failed for {op.name} "

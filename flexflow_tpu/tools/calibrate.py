@@ -64,19 +64,50 @@ def candidate_jobs(model, nd: int, cost, full: bool) -> List[Tuple]:
     return jobs
 
 
-def run_measurements(jobs, cost, max_seconds: float, verbose: bool) -> int:
-    done = 0
+def run_measurements(jobs, cost, max_seconds: float, verbose: bool,
+                     job_timeout: float = 300.0) -> int:
+    """Measure every job, with a per-job watchdog: a wedged TPU tunnel
+    hangs ALL device work indefinitely, so after two consecutive hung
+    jobs measuring aborts (keeping everything persisted so far) instead
+    of stalling the whole calibration run."""
+    import signal
+
+    done, hung = 0, 0
     t_start = time.time()
-    for i, (op, pc, which, key) in enumerate(jobs):
-        if time.time() - t_start > max_seconds:
-            print(f"[calibrate] time budget hit after {done}/{len(jobs)} jobs")
-            break
-        t = cost.op_time(op, pc, which)
-        done += 1
-        if verbose:
-            src = "measured" if key in cost._measured else "ANALYTIC(fallback)"
-            print(f"[{i + 1}/{len(jobs)}] {key} -> {t * 1e6:.1f} us [{src}]",
-                  flush=True)
+
+    def _alarm(signum, frame):
+        raise TimeoutError("measurement hung (tunnel wedged?)")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    try:
+        for i, (op, pc, which, key) in enumerate(jobs):
+            if time.time() - t_start > max_seconds:
+                print(f"[calibrate] time budget hit after "
+                      f"{done}/{len(jobs)} jobs")
+                break
+            signal.alarm(int(job_timeout))
+            try:
+                t = cost.op_time(op, pc, which)
+                hung = 0
+            except TimeoutError:
+                hung += 1
+                print(f"[calibrate] job {i + 1} hung >{job_timeout:.0f}s "
+                      f"({key}) — {'aborting' if hung >= 2 else 'skipping'}",
+                      flush=True)
+                if hung >= 2:
+                    break
+                continue
+            finally:
+                signal.alarm(0)
+            done += 1
+            if verbose:
+                src = ("measured" if key in cost._measured
+                       else "ANALYTIC(fallback)")
+                print(f"[{i + 1}/{len(jobs)}] {key} -> {t * 1e6:.1f} us "
+                      f"[{src}]", flush=True)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
     return done
 
 

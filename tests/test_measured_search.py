@@ -161,3 +161,29 @@ def test_soap_report_generator(tmp_path, devices):
     assert res["speedup"] >= 1.0
     text = open(out).read()
     assert "SOAP searched" in text and "agreement" in text.lower()
+
+
+def test_fit_machine_recovers_known_constants():
+    """fit_machine's grid fit recovers roofline constants from synthetic
+    records generated BY that roofline (sanity for the calibration
+    math)."""
+    from flexflow_tpu.simulator.machine import TPUMachineModel
+    from flexflow_tpu.tools.calibrate import fit_machine
+
+    mm = TPUMachineModel(num_devices=1)
+    eff, hbm_frac, ovh = 0.52, 0.8, 4e-6
+    rng = np.random.default_rng(0)
+    recs = []
+    for _ in range(64):
+        flops = float(10 ** rng.uniform(6, 11))
+        byts = float(10 ** rng.uniform(4, 8))
+        t = max(flops / (mm.peak_flops * eff),
+                byts / (mm.hbm_bandwidth * hbm_frac)) + ovh
+        recs.append({"flops": flops, "bytes": byts, "t_fwd": t,
+                     "t_bwd": 2.1 * t})
+    fit = fit_machine(recs, mm)
+    assert abs(fit["mxu_efficiency"] - eff) < 0.03
+    assert abs(fit["hbm_bandwidth"] / mm.hbm_bandwidth - hbm_frac) < 0.07
+    assert fit["kernel_launch_overhead"] == 4e-6
+    assert abs(fit["backward_multiplier"] - 2.1) < 0.05
+    assert fit["fit_log_rmse"] < 0.05
