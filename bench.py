@@ -26,14 +26,36 @@ sys.path.insert(0, ".")
 
 PER_CHIP_BASELINE = 375.0  # samples/s/chip parity bar (see docstring)
 PEAK_FLOPS = 197e12        # v5e bf16
+TRANSFORMER_SEQ = 512      # bench transformer sequence length
+TRANSFORMER_VOCAB = 32000
 
 
 def _build(name, batch_size, compute_dtype, fused=False):
+    import numpy as np
+
     import flexflow_tpu as ff
 
     cfg = ff.FFConfig(batch_size=batch_size, compute_dtype=compute_dtype,
                       fused_optimizer=fused)
     model = ff.FFModel(cfg)
+    if name == "transformer":
+        # GPT-small-ish block stack; sp=1 so attention runs the fused
+        # Pallas flash kernel on-chip (kernels/flash_attention.py)
+        from flexflow_tpu.models.transformer import (build_transformer,
+                                                     synthetic_lm_batch)
+        tok, pos, _ = build_transformer(model, batch_size,
+                                        seq_length=TRANSFORMER_SEQ,
+                                        num_layers=4, embed_dim=512,
+                                        num_heads=8,
+                                        vocab_size=TRANSFORMER_VOCAB)
+        model.compile(ff.SGDOptimizer(model, lr=0.001),
+                      ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                      [ff.MetricsType.ACCURACY])
+        model.init_layers()
+        toks, posa, labels = synthetic_lm_batch(batch_size, TRANSFORMER_SEQ,
+                                                TRANSFORMER_VOCAB)
+        model.set_batch({tok: toks, pos: posa}, labels)
+        return model
     if name == "alexnet":
         from flexflow_tpu.models.alexnet import build_alexnet
         inp, _ = build_alexnet(model, batch_size)
@@ -141,6 +163,17 @@ def main():
                 "mfu": round(mfu_i, 3)}
         except Exception as e:
             extra["inception_v3"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            # decoder transformer: MXU-dense matmuls + the fused Pallas
+            # flash-attention kernel (tokens/s = samples/s * seq 512)
+            sps_t, tf_t, mfu_t = run_one("transformer", batch_size=16,
+                                         steps=12)
+            extra["transformer"] = {
+                "tokens_per_sec_per_chip": round(sps_t * TRANSFORMER_SEQ, 1),
+                "achieved_tflops": round(tf_t, 1),
+                "mfu": round(mfu_t, 3)}
+        except Exception as e:
+            extra["transformer"] = {"error": f"{type(e).__name__}: {e}"}
         try:
             # fused Pallas optimizer kernels on the real chip (single
             # device): proves they compile+run outside interpret mode

@@ -54,11 +54,9 @@ def top_level_task(argv=None, seq=64, layers=4, dim=128, heads=8,
                   [ff.MetricsType.ACCURACY])
     model.init_layers()
 
-    rng = np.random.default_rng(0)
-    toks = rng.integers(0, vocab, size=(cfg.batch_size, seq)).astype(np.int32)
-    posa = np.broadcast_to(np.arange(seq, dtype=np.int32),
-                           (cfg.batch_size, seq)).copy()
-    labels = np.roll(toks, -1, axis=1).astype(np.int32)
+    from flexflow_tpu.models.transformer import synthetic_lm_batch
+
+    toks, posa, labels = synthetic_lm_batch(cfg.batch_size, seq, vocab)
     model.set_batch({tok: toks, pos: posa}, labels)
     model.train_iteration()
     model.sync()

@@ -60,3 +60,18 @@ def build_transformer(ff: FFModel, batch_size: int, seq_length: int = 256,
     logits = ff.dense(x, vocab_size, name="lm_head")
     out = ff.softmax(logits, name="softmax")
     return tok, pos, out
+
+
+def synthetic_lm_batch(batch_size: int, seq_length: int, vocab_size: int,
+                       seed: int = 0):
+    """(tokens, positions, next-token labels) for a synthetic LM step —
+    the one recipe shared by the example, the bench, and the dryrun."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab_size,
+                        size=(batch_size, seq_length)).astype(np.int32)
+    posa = np.broadcast_to(np.arange(seq_length, dtype=np.int32),
+                           (batch_size, seq_length)).copy()
+    labels = np.roll(toks, -1, axis=1).astype(np.int32)
+    return toks, posa, labels
