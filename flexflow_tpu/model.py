@@ -1229,6 +1229,122 @@ class FFModel:
         return np.asarray(probs)
 
     # ------------------------------------------------------------------
+    # autoregressive generation (beyond the reference, which is
+    # training-only: kv-cached decoding as one jitted lax.scan —
+    # static shapes, no per-token retrace)
+    # ------------------------------------------------------------------
+    def _run_graph_decode(self, params, caches, batch, pos, ctx):
+        env: Dict[int, jax.Array] = {}
+        cdtype = self.compute_dtype
+        for t in self.input_tensors:
+            key = f"in_{t.guid}"
+            if key not in batch:
+                continue
+            x = batch[key]
+            if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdtype:
+                x = x.astype(cdtype)
+            env[t.guid] = x
+        for t, val in self._constants.values():
+            fill_dtype = jnp.int32 if "int" in t.dtype else cdtype
+            env[t.guid] = jnp.full(t.dims, val, fill_dtype)
+        new_caches = {}
+        for op in self.ops:
+            xs = [env[t.guid] for t in op.inputs]
+            ys, c = op.decode(params.get(op.param_key, {}), xs,
+                              caches.get(op.name), pos, ctx)
+            new_caches[op.name] = c
+            for t, y in zip(op.outputs, ys):
+                env[t.guid] = y
+        return env, new_caches
+
+    def generate(self, prompt_tokens, max_new_tokens: int, *,
+                 tokens_input: Optional[Tensor] = None,
+                 positions_input: Optional[Tensor] = None,
+                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Generate ``max_new_tokens`` continuations for a (B, P) int32
+        prompt with kv-cached greedy (temperature=0) or sampled
+        decoding.  The whole prefill+decode loop is ONE jitted
+        ``lax.scan`` over P+N-1 single-token steps — each attention op
+        carries a (B, H, P+N, head_dim) cache written in place.
+
+        ``tokens_input``/``positions_input`` default to the model's
+        first/second graph inputs (the ``build_transformer`` layout).
+        """
+        assert self._compiled, "call compile() first"
+        toks = jnp.asarray(prompt_tokens, jnp.int32)
+        B, P = toks.shape
+        N = int(max_new_tokens)
+        if N <= 0:
+            return np.zeros((B, 0), np.int32)
+        tok_t = tokens_input or self.input_tensors[0]
+        pos_t = positions_input
+        if pos_t is None and len(self.input_tensors) > 1:
+            pos_t = self.input_tensors[1]
+        s_max = P + N
+        if pos_t is not None:
+            # jnp.take clamps OOB position lookups under jit — catch an
+            # overlong request here instead of degrading silently
+            for op in self.ops:
+                if isinstance(op, Embedding) and op.inputs[0] is pos_t \
+                        and s_max > op.num_entries:
+                    raise ValueError(
+                        f"generate: prompt + max_new_tokens = {s_max} "
+                        f"exceeds the position table "
+                        f"({op.num_entries} entries)")
+        cdtype = self.compute_dtype
+        final_guid = self.final_tensor().guid
+        temp = float(temperature)
+
+        def step(params, stats, carry, inp):
+            caches, tok, pos, key = carry
+            feed_tok, use_feed = inp
+            cur = jnp.where(use_feed, feed_tok, tok)          # (B,)
+            batch = {f"in_{tok_t.guid}": cur[:, None]}
+            if pos_t is not None:
+                batch[f"in_{pos_t.guid}"] = jnp.full((B, 1), pos, jnp.int32)
+            ctx = FwdCtx(training=False,
+                         rng=jax.random.key(self.config.seed),
+                         stats_in=stats)
+            env, caches = self._run_graph_decode(params, caches, batch,
+                                                 pos, ctx)
+            probs = env[final_guid][:, -1, :].astype(jnp.float32)  # (B, V)
+            if temp > 0.0:
+                key, k = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    k, jnp.log(probs + 1e-9) / temp, axis=-1)
+            else:
+                nxt = jnp.argmax(probs, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            return (caches, nxt, pos + 1, key), nxt
+
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        ckey = (B, P, N, temp, seed, tok_t.guid,
+                pos_t.guid if pos_t is not None else None)
+        run = cache.get(ckey)
+        if run is None:
+            @jax.jit
+            def run(params, stats, feed, use):
+                caches0 = {op.name: op.init_cache(B, s_max, cdtype)
+                           for op in self.ops}
+                carry0 = (caches0, jnp.zeros((B,), jnp.int32),
+                          jnp.zeros((), jnp.int32), jax.random.key(seed))
+                _, outs = jax.lax.scan(
+                    lambda c, i: step(params, stats, c, i), carry0,
+                    (feed, use))
+                return outs                                   # (P+N-1, B)
+
+            cache[ckey] = run
+
+        feed = jnp.concatenate(
+            [toks.T, jnp.zeros((N - 1, B), jnp.int32)]) if N > 1 else toks.T
+        use = jnp.concatenate([jnp.ones((P,), bool),
+                               jnp.zeros((N - 1,), bool)])
+        outs = run(self._params, self._stats, feed, use)
+        return np.asarray(outs[P - 1:].T)                     # (B, N)
+
+    # ------------------------------------------------------------------
     # metrics (reference: UPDATE_METRICS_TASK fold, model.cc:1145-1167)
     # ------------------------------------------------------------------
     def reset_metrics(self) -> None:

@@ -158,6 +158,39 @@ class MultiHeadAttention(Op):
             out = jnp.where(mask, out / keep, 0.0).astype(out.dtype)
         return [self._proj(params, out, "wo", "bo")]
 
+    def init_cache(self, batch_size: int, max_len: int, dtype):
+        shp = (batch_size, self.num_heads, max_len, self.head_dim)
+        return {"k": jnp.zeros(shp, dtype), "v": jnp.zeros(shp, dtype)}
+
+    def decode(self, params, xs, cache, pos, ctx):
+        """kv-cached single-token attention: append this step's k/v at
+        ``pos``, attend q over the cache prefix (static shapes — the
+        future positions are masked, not sliced)."""
+        from jax import lax
+
+        q_in, k_in, v_in = xs
+        B, S1, _ = q_in.shape
+        H, D = self.num_heads, self.head_dim
+        q = self._proj(params, q_in, "wq", "bq")
+        k = self._proj(params, k_in, "wk", "bk")
+        v = self._proj(params, v_in, "wv", "bv")
+        split = lambda t: t.reshape(B, S1, H, D).transpose(0, 2, 1, 3)
+        qh, kh, vh = split(q), split(k), split(v)            # (B, H, 1, D)
+        ck = lax.dynamic_update_slice(
+            cache["k"], kh.astype(cache["k"].dtype), (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(
+            cache["v"], vh.astype(cache["v"].dtype), (0, 0, pos, 0))
+        scale = 1.0 / math.sqrt(D)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                            ck.astype(jnp.float32)) * scale
+        valid = jnp.arange(ck.shape[2])[None, None, None, :] <= pos
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                         cv.astype(jnp.float32)).astype(q_in.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S1, self.embed_dim)
+        return [self._proj(params, out, "wo", "bo")], {"k": ck, "v": cv}
+
     def flops_per_sample(self):
         _, sq, e = self.output.dims
         sk = self.inputs[1].dims[1]

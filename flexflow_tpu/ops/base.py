@@ -87,6 +87,18 @@ class Op:
     def forward(self, params: Dict[str, jax.Array], xs: List[jax.Array], ctx: FwdCtx) -> List[jax.Array]:
         raise NotImplementedError
 
+    # -- autoregressive decoding (FFModel.generate) ------------------------
+    def init_cache(self, batch_size: int, max_len: int, dtype):
+        """Decode-cache pytree for kv-cached generation; None for
+        stateless ops."""
+        return None
+
+    def decode(self, params, xs: List[jax.Array], cache, pos, ctx: FwdCtx):
+        """One-token decode step at sequence position ``pos`` (scalar
+        int array).  ``xs`` carry a single time step (B, 1, ...).
+        Returns (ys, new_cache).  Default: stateless forward."""
+        return self.forward(params, xs, ctx), cache
+
     def constraint_pc(self):
         """ParallelConfig used to place this op's OUTPUT activations.
         Defaults to the op's own config; ops whose config dims carry

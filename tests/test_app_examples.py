@@ -35,3 +35,9 @@ def test_candle_uno_driver():
     from examples.candle_uno import main
 
     main(["-b", "8", "--epochs", "1"])
+
+
+def test_transformer_generate_example():
+    from examples.transformer_generate import top_level_task
+
+    assert top_level_task(argv=[], iterations=120) >= 90.0
