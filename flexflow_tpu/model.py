@@ -1239,7 +1239,9 @@ class FFModel:
         for t in self.input_tensors:
             key = f"in_{t.guid}"
             if key not in batch:
-                continue
+                raise ValueError(
+                    f"generate: graph input {t.name or t.guid!r} was not "
+                    f"fed — pass it via extra_inputs")
             x = batch[key]
             if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdtype:
                 x = x.astype(cdtype)
@@ -1260,6 +1262,7 @@ class FFModel:
     def generate(self, prompt_tokens, max_new_tokens: int, *,
                  tokens_input: Optional[Tensor] = None,
                  positions_input: Optional[Tensor] = None,
+                 extra_inputs: Optional[Dict[Tensor, Any]] = None,
                  temperature: float = 0.0, seed: int = 0) -> np.ndarray:
         """Generate ``max_new_tokens`` continuations for a (B, P) int32
         prompt with kv-cached greedy (temperature=0) or sampled
@@ -1269,6 +1272,10 @@ class FFModel:
 
         ``tokens_input``/``positions_input`` default to the model's
         first/second graph inputs (the ``build_transformer`` layout).
+        ``extra_inputs`` maps further graph inputs to FIXED full arrays
+        fed every step — e.g. the source sentence of a seq2seq model
+        (its encoder ops re-run per step; the decoder LSTMs carry their
+        state in the decode cache).
         """
         assert self._compiled, "call compile() first"
         toks = jnp.asarray(prompt_tokens, jnp.int32)
@@ -1278,7 +1285,10 @@ class FFModel:
             return np.zeros((B, 0), np.int32)
         tok_t = tokens_input or self.input_tensors[0]
         pos_t = positions_input
-        if pos_t is None and len(self.input_tensors) > 1:
+        if pos_t is None and tokens_input is None \
+                and len(self.input_tensors) > 1:
+            # transformer layout (tokens, positions) — only guessed when
+            # the tokens input was also defaulted
             pos_t = self.input_tensors[1]
         s_max = P + N
         if pos_t is not None:
@@ -1293,13 +1303,13 @@ class FFModel:
                         f"({op.num_entries} entries)")
         cdtype = self.compute_dtype
         final_guid = self.final_tensor().guid
-        temp = float(temperature)
+        sampled = float(temperature) > 0.0
 
-        def step(params, stats, carry, inp):
+        def step(params, stats, extra, temp, carry, inp):
             caches, tok, pos, key = carry
             feed_tok, use_feed = inp
             cur = jnp.where(use_feed, feed_tok, tok)          # (B,)
-            batch = {f"in_{tok_t.guid}": cur[:, None]}
+            batch = {f"in_{tok_t.guid}": cur[:, None], **extra}
             if pos_t is not None:
                 batch[f"in_{pos_t.guid}"] = jnp.full((B, 1), pos, jnp.int32)
             ctx = FwdCtx(training=False,
@@ -1308,7 +1318,7 @@ class FFModel:
             env, caches = self._run_graph_decode(params, caches, batch,
                                                  pos, ctx)
             probs = env[final_guid][:, -1, :].astype(jnp.float32)  # (B, V)
-            if temp > 0.0:
+            if sampled:
                 key, k = jax.random.split(key)
                 nxt = jax.random.categorical(
                     k, jnp.log(probs + 1e-9) / temp, axis=-1)
@@ -1317,22 +1327,27 @@ class FFModel:
             nxt = nxt.astype(jnp.int32)
             return (caches, nxt, pos + 1, key), nxt
 
+        extra = {f"in_{t.guid}": jnp.asarray(v)
+                 for t, v in (extra_inputs or {}).items()}
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
-        ckey = (B, P, N, temp, seed, tok_t.guid,
-                pos_t.guid if pos_t is not None else None)
+        # seed/temperature are runtime ARGUMENTS (key0/temp below), not
+        # trace constants — new seeds reuse the compiled scan
+        ckey = (B, P, N, sampled, tok_t.guid,
+                pos_t.guid if pos_t is not None else None,
+                tuple(sorted((k, v.shape) for k, v in extra.items())))
         run = cache.get(ckey)
         if run is None:
             @jax.jit
-            def run(params, stats, feed, use):
+            def run(params, stats, extra, feed, use, key0, temp):
                 caches0 = {op.name: op.init_cache(B, s_max, cdtype)
                            for op in self.ops}
                 carry0 = (caches0, jnp.zeros((B,), jnp.int32),
-                          jnp.zeros((), jnp.int32), jax.random.key(seed))
+                          jnp.zeros((), jnp.int32), key0)
                 _, outs = jax.lax.scan(
-                    lambda c, i: step(params, stats, c, i), carry0,
-                    (feed, use))
+                    lambda c, i: step(params, stats, extra, temp, c, i),
+                    carry0, (feed, use))
                 return outs                                   # (P+N-1, B)
 
             cache[ckey] = run
@@ -1341,7 +1356,9 @@ class FFModel:
             [toks.T, jnp.zeros((N - 1, B), jnp.int32)]) if N > 1 else toks.T
         use = jnp.concatenate([jnp.ones((P,), bool),
                                jnp.zeros((N - 1,), bool)])
-        outs = run(self._params, self._stats, feed, use)
+        outs = run(self._params, self._stats, extra, feed, use,
+                   jax.random.key(seed),
+                   jnp.asarray(float(temperature), jnp.float32))
         return np.asarray(outs[P - 1:].T)                     # (B, N)
 
     # ------------------------------------------------------------------

@@ -64,3 +64,19 @@ def synthetic_batch(batch_size: int, seq_length: int, vocab_size: int, seed: int
     dst = rng.integers(0, vocab_size, size=(batch_size, seq_length), dtype=np.int32)
     labels = rng.integers(0, vocab_size, size=(batch_size, seq_length), dtype=np.int32)
     return src, dst, labels
+
+
+def greedy_translate(model: "FFModel", src_tensor, dst_tensor, src_tokens,
+                     max_len: int, bos_id: int = 1):
+    """Greedy seq2seq decoding: encode ``src_tokens`` and emit
+    ``max_len`` target tokens starting from ``bos_id`` (beyond the
+    training-only reference NMT).  Rides FFModel.generate's kv/state-
+    cached scan: the source rides along as a fixed extra input (the
+    encoder ops re-run per step), the decoder LSTMs advance their
+    cached (h, c) carry one token at a time."""
+    src_tokens = np.asarray(src_tokens, np.int32)
+    b = src_tokens.shape[0]
+    prompt = np.full((b, 1), bos_id, np.int32)
+    return model.generate(prompt, max_len, tokens_input=dst_tensor,
+                          positions_input=None,
+                          extra_inputs={src_tensor: src_tokens})

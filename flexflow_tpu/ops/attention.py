@@ -165,10 +165,15 @@ class MultiHeadAttention(Op):
     def decode(self, params, xs, cache, pos, ctx):
         """kv-cached single-token attention: append this step's k/v at
         ``pos``, attend q over the cache prefix (static shapes — the
-        future positions are masked, not sliced)."""
+        future positions are masked, not sliced).  Full-sequence or
+        non-causal calls (an encoder re-run per step, or cross-attention
+        with a single-token q over full-sequence k/v) are stateless —
+        fall back to forward."""
         from jax import lax
 
         q_in, k_in, v_in = xs
+        if q_in.shape[1] != 1 or k_in.shape[1] != 1 or not self.causal:
+            return self.forward(params, xs, ctx), cache
         B, S1, _ = q_in.shape
         H, D = self.num_heads, self.head_dim
         q = self._proj(params, q_in, "wq", "bq")

@@ -102,12 +102,7 @@ class LSTM(Op):
             h_prev, c_prev = carry
             z = xz_t + jnp.dot(h_prev.astype(dt), w_hh,
                                preferred_element_type=acc).astype(jnp.float32)
-            # (B, 4, H) so each gate's H dim carries the same sharding
-            # under hidden-TP (a flat 4H split would straddle gates).
-            z = z.reshape(z.shape[0], 4, h)
-            i, f, g, o = z[:, 0], z[:, 1], z[:, 2], z[:, 3]
-            c_new = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
-            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            h_new, c_new = LSTM._gates(z, c_prev, h)
             h_next = (h_new if h == H_full
                       else jnp.tile(h_new, (1, H_full // h)))
             return (h_next, c_new), h_new
@@ -115,6 +110,52 @@ class LSTM(Op):
         (_, c_t), ys = lax.scan(step, (h0, c0), xz)
         y = jnp.swapaxes(ys, 0, 1).astype(dt)  # (B, T, H)
         return [y, ys[-1].astype(dt), c_t.astype(dt)]
+
+    @staticmethod
+    def _gates(z, c_prev, h):
+        """The LSTM cell from pre-activation gates z (B, 4H) — the ONE
+        copy forward's scan body and decode both use.  (B, 4, H) so each
+        gate's H dim carries the same sharding under hidden-TP (a flat
+        4H split would straddle gates)."""
+        z = z.reshape(z.shape[0], 4, h)
+        i, f, g, o = z[:, 0], z[:, 1], z[:, 2], z[:, 3]
+        c_new = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def init_cache(self, batch_size: int, max_len: int, dtype):
+        h = self.hidden_size
+        return {"h": jnp.zeros((batch_size, h), jnp.float32),
+                "c": jnp.zeros((batch_size, h), jnp.float32)}
+
+    def decode(self, params, xs, cache, pos, ctx):
+        """Single-token recurrence step.  A full-sequence input (an
+        encoder pass re-run each step) falls back to forward; a (B, 1, E)
+        input advances the cached (h, c) carry — at pos 0 the carry
+        seeds from the hx/cx graph inputs (the encoder's final state),
+        matching forward's initialization."""
+        x = xs[0]
+        if x.shape[1] != 1:
+            return self.forward(params, xs, ctx), cache
+        dt = x.dtype
+        acc = jnp.float32 if dt == jnp.bfloat16 else None
+        w_ih = params["w_ih"].astype(dt)
+        w_hh = params["w_hh"].astype(dt)
+        bias = params["bias"].astype(jnp.float32)
+        h_dim = w_ih.shape[1] // 4
+        if self.has_state_inputs:
+            h0 = jnp.where(pos == 0, xs[1].astype(jnp.float32), cache["h"])
+            c0 = jnp.where(pos == 0, xs[2].astype(jnp.float32), cache["c"])
+        else:
+            h0, c0 = cache["h"], cache["c"]
+        z = jnp.dot(x[:, 0, :], w_ih, preferred_element_type=acc)
+        z = z.astype(jnp.float32) + bias
+        z = z + jnp.dot(h0.astype(dt), w_hh,
+                        preferred_element_type=acc).astype(jnp.float32)
+        h_new, c_new = LSTM._gates(z, c0, h_dim)
+        y = h_new[:, None, :].astype(dt)
+        return ([y, h_new.astype(dt), c_new.astype(dt)],
+                {"h": h_new, "c": c_new})
 
     def flops_per_sample(self):
         _, t, e = self.inputs[0].dims
