@@ -1233,10 +1233,13 @@ class FFModel:
     # training-only: kv-cached decoding as one jitted lax.scan —
     # static shapes, no per-token retrace)
     # ------------------------------------------------------------------
-    def _run_graph_decode(self, params, caches, batch, pos, ctx):
-        env: Dict[int, jax.Array] = {}
+    def _run_graph_decode(self, params, caches, batch, pos, ctx,
+                          pre_env=None, skip=()):
+        env: Dict[int, jax.Array] = dict(pre_env) if pre_env else {}
         cdtype = self.compute_dtype
         for t in self.input_tensors:
+            if t.guid in env:
+                continue
             key = f"in_{t.guid}"
             if key not in batch:
                 raise ValueError(
@@ -1247,10 +1250,13 @@ class FFModel:
                 x = x.astype(cdtype)
             env[t.guid] = x
         for t, val in self._constants.values():
-            fill_dtype = jnp.int32 if "int" in t.dtype else cdtype
-            env[t.guid] = jnp.full(t.dims, val, fill_dtype)
+            if t.guid not in env:
+                fill_dtype = jnp.int32 if "int" in t.dtype else cdtype
+                env[t.guid] = jnp.full(t.dims, val, fill_dtype)
         new_caches = {}
         for op in self.ops:
+            if op.name in skip:
+                continue
             xs = [env[t.guid] for t in op.inputs]
             ys, c = op.decode(params.get(op.param_key, {}), xs,
                               caches.get(op.name), pos, ctx)
@@ -1305,7 +1311,36 @@ class FFModel:
         final_guid = self.final_tensor().guid
         sampled = float(temperature) > 0.0
 
-        def step(params, stats, extra, temp, carry, inp):
+        # Ops reachable from the FIXED extra inputs alone (a seq2seq
+        # encoder) run ONCE before the scan, not once per token.
+        extra_guids = {t.guid for t in (extra_inputs or {})}
+        static_avail = set(extra_guids)
+        static_avail.update(t.guid for t, _ in self._constants.values())
+        static_ops = []
+        if extra_guids:
+            for op in self.ops:
+                if op.inputs and all(t.guid in static_avail
+                                     for t in op.inputs):
+                    static_ops.append(op)
+                    static_avail.update(t.guid for t in op.outputs)
+        static_names = frozenset(op.name for op in static_ops)
+
+        def prefill_static(params, stats, extra):
+            env = {g: extra[f"in_{g}"] for g in extra_guids}
+            for t, val in self._constants.values():
+                fdt = jnp.int32 if "int" in t.dtype else cdtype
+                env[t.guid] = jnp.full(t.dims, val, fdt)
+            ctx = FwdCtx(training=False,
+                         rng=jax.random.key(self.config.seed),
+                         stats_in=stats)
+            for op in static_ops:
+                xs = [env[t.guid] for t in op.inputs]
+                ys = op.forward(params.get(op.param_key, {}), xs, ctx)
+                for t, y in zip(op.outputs, ys):
+                    env[t.guid] = y
+            return env
+
+        def step(params, stats, extra, pre_env, temp, carry, inp):
             caches, tok, pos, key = carry
             feed_tok, use_feed = inp
             cur = jnp.where(use_feed, feed_tok, tok)          # (B,)
@@ -1316,7 +1351,8 @@ class FFModel:
                          rng=jax.random.key(self.config.seed),
                          stats_in=stats)
             env, caches = self._run_graph_decode(params, caches, batch,
-                                                 pos, ctx)
+                                                 pos, ctx, pre_env=pre_env,
+                                                 skip=static_names)
             probs = env[final_guid][:, -1, :].astype(jnp.float32)  # (B, V)
             if sampled:
                 key, k = jax.random.split(key)
@@ -1341,12 +1377,14 @@ class FFModel:
         if run is None:
             @jax.jit
             def run(params, stats, extra, feed, use, key0, temp):
+                pre_env = prefill_static(params, stats, extra)
                 caches0 = {op.name: op.init_cache(B, s_max, cdtype)
-                           for op in self.ops}
+                           for op in self.ops if op.name not in static_names}
                 carry0 = (caches0, jnp.zeros((B,), jnp.int32),
                           jnp.zeros((), jnp.int32), key0)
                 _, outs = jax.lax.scan(
-                    lambda c, i: step(params, stats, extra, temp, c, i),
+                    lambda c, i: step(params, stats, extra, pre_env, temp,
+                                      c, i),
                     carry0, (feed, use))
                 return outs                                   # (P+N-1, B)
 

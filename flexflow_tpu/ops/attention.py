@@ -172,8 +172,16 @@ class MultiHeadAttention(Op):
         from jax import lax
 
         q_in, k_in, v_in = xs
-        if q_in.shape[1] != 1 or k_in.shape[1] != 1 or not self.causal:
+        if q_in.shape[1] != 1 or k_in.shape[1] != 1:
+            # full-sequence pass (an encoder re-run, or cross-attention
+            # q over full k/v) — stateless, forward is correct
             return self.forward(params, xs, ctx), cache
+        if not self.causal:
+            # a 1-token non-causal self-attention step would silently
+            # attend only itself; no valid cache semantics exist for it
+            raise ValueError(
+                f"generate: op {self.name!r} is non-causal single-token "
+                f"self-attention — not decodable")
         B, S1, _ = q_in.shape
         H, D = self.num_heads, self.head_dim
         q = self._proj(params, q_in, "wq", "bq")

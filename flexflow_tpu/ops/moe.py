@@ -137,6 +137,39 @@ class ExpertMLP(Op):
                        y_e.astype(jnp.float32)).astype(dt)
         return [y.reshape(shape)]
 
+    def decode(self, params, xs, cache, pos, ctx):
+        """Dropless single-step routing: at decode only B tokens route
+        per step, so the training-time capacity cut (which zeroes
+        overflow tokens) would silently corrupt generations — compute
+        every token's CHOSEN expert exactly instead.  Matches forward
+        bit-for-bit whenever forward's capacity drops nothing."""
+        x = xs[0]
+        shape = x.shape
+        d = shape[-1]
+        dt = x.dtype
+        s = 1
+        for dim in shape[:-1]:
+            s *= dim
+        xf = x.reshape(s, d)
+        e = params["w_in"].shape[0]
+        logits = jnp.dot(xf.astype(jnp.float32),
+                         params["router"].astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+        gate = jnp.max(gates, axis=-1)                     # (S,)
+        onehot = jax.nn.one_hot(jnp.argmax(gates, axis=-1), e,
+                                dtype=jnp.float32)         # (S, E)
+        h = jnp.einsum("sd,edh->seh", xf.astype(dt), params["w_in"].astype(dt))
+        h = h + params["b_in"].astype(h.dtype)[None, :, :]
+        if self.activation == "relu":
+            h = jax.nn.relu(h)
+        elif self.activation == "gelu":
+            h = jax.nn.gelu(h)
+        y_e = jnp.einsum("seh,ehd->sed", h, params["w_out"].astype(dt))
+        y_e = y_e + params["b_out"].astype(y_e.dtype)[None, :, :]
+        y = jnp.einsum("se,sed->sd", onehot * gate[:, None],
+                       y_e.astype(jnp.float32)).astype(dt)
+        return [y.reshape(shape)], cache
+
     def _expert_constraint(self, a):
         """Pin the expert dim of (E, C, ...) intermediates to the ep mesh
         axes so GSPMD places per-expert compute on its shard (and emits

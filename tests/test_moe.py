@@ -121,3 +121,41 @@ def test_capacity_and_dropped_tokens():
     assert isinstance(op, ExpertMLP)
     assert op.capacity(8) == 4
     assert op.capacity(10) == 5
+
+
+def test_moe_transformer_generate(devices):
+    """generate() through Switch-MoE blocks (ExpertMLP decodes via its
+    stateless forward); greedy output pinned to the full-forward oracle
+    at a size where expert capacity drops nothing."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models.transformer import build_transformer
+
+    S, V, B, P, N = 16, 40, 4, 5, 5
+    cfg = ff.FFConfig(batch_size=B)
+    m = ff.FFModel(cfg)
+    tok, pos, _ = build_transformer(m, B, seq_length=S, num_layers=2,
+                                    embed_dim=32, num_heads=4, vocab_size=V,
+                                    moe_every=2, num_experts=4)
+    m.compile(ff.SGDOptimizer(lr=0.01), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=7)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, V, size=(B, P)).astype(np.int32)
+    out = m.generate(prompt, N)
+    assert out.shape == (B, N)
+
+    seq = prompt.copy()
+    for _ in range(N):
+        L = seq.shape[1]
+        tf = np.zeros((B, S), np.int32)
+        tf[:, :L] = seq
+        posa = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S)).copy()
+        env, _ = m._run_graph(m._params, m._stats,
+                              {f"in_{tok.guid}": jnp.asarray(tf),
+                               f"in_{pos.guid}": jnp.asarray(posa)},
+                              False, None)
+        nxt = np.asarray(env[m.final_tensor().guid])[:, L - 1, :] \
+            .argmax(-1).astype(np.int32)
+        seq = np.concatenate([seq, nxt[:, None]], 1)
+    np.testing.assert_array_equal(out, seq[:, P:])
