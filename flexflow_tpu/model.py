@@ -1265,6 +1265,50 @@ class FFModel:
                 env[t.guid] = y
         return env, new_caches
 
+    def _check_position_table(self, pos_t, s_max: int) -> None:
+        """jnp.take clamps OOB position lookups under jit — catch an
+        overlong request instead of degrading silently."""
+        if pos_t is None:
+            return
+        for op in self.ops:
+            if isinstance(op, Embedding) and op.inputs[0] is pos_t \
+                    and s_max > op.num_entries:
+                raise ValueError(
+                    f"decode: prompt + max_new_tokens = {s_max} exceeds "
+                    f"the position table ({op.num_entries} entries)")
+
+    def _static_decode_ops(self, extra_guids):
+        """Ops reachable from the FIXED extra inputs alone (a seq2seq
+        encoder): run once before the decode scan, not once per token."""
+        avail = set(extra_guids)
+        avail.update(t.guid for t, _ in self._constants.values())
+        static_ops = []
+        if extra_guids:
+            for op in self.ops:
+                if op.inputs and all(t.guid in avail for t in op.inputs):
+                    static_ops.append(op)
+                    avail.update(t.guid for t in op.outputs)
+        return static_ops, frozenset(op.name for op in static_ops)
+
+    def _prefill_static(self, params, stats, extra, extra_guids,
+                        static_ops, repeat: int = 1):
+        cdtype = self.compute_dtype
+        env = {}
+        for g in extra_guids:
+            x = extra[f"in_{g}"]
+            env[g] = jnp.repeat(x, repeat, axis=0) if repeat > 1 else x
+        for t, val in self._constants.values():
+            fdt = jnp.int32 if "int" in t.dtype else cdtype
+            env[t.guid] = jnp.full(t.dims, val, fdt)
+        ctx = FwdCtx(training=False, rng=jax.random.key(self.config.seed),
+                     stats_in=stats)
+        for op in static_ops:
+            xs = [env[t.guid] for t in op.inputs]
+            ys = op.forward(params.get(op.param_key, {}), xs, ctx)
+            for t, y in zip(op.outputs, ys):
+                env[t.guid] = y
+        return env
+
     def generate(self, prompt_tokens, max_new_tokens: int, *,
                  tokens_input: Optional[Tensor] = None,
                  positions_input: Optional[Tensor] = None,
@@ -1297,54 +1341,19 @@ class FFModel:
             # the tokens input was also defaulted
             pos_t = self.input_tensors[1]
         s_max = P + N
-        if pos_t is not None:
-            # jnp.take clamps OOB position lookups under jit — catch an
-            # overlong request here instead of degrading silently
-            for op in self.ops:
-                if isinstance(op, Embedding) and op.inputs[0] is pos_t \
-                        and s_max > op.num_entries:
-                    raise ValueError(
-                        f"generate: prompt + max_new_tokens = {s_max} "
-                        f"exceeds the position table "
-                        f"({op.num_entries} entries)")
+        self._check_position_table(pos_t, s_max)
         cdtype = self.compute_dtype
         final_guid = self.final_tensor().guid
         sampled = float(temperature) > 0.0
 
-        # Ops reachable from the FIXED extra inputs alone (a seq2seq
-        # encoder) run ONCE before the scan, not once per token.
         extra_guids = {t.guid for t in (extra_inputs or {})}
-        static_avail = set(extra_guids)
-        static_avail.update(t.guid for t, _ in self._constants.values())
-        static_ops = []
-        if extra_guids:
-            for op in self.ops:
-                if op.inputs and all(t.guid in static_avail
-                                     for t in op.inputs):
-                    static_ops.append(op)
-                    static_avail.update(t.guid for t in op.outputs)
-        static_names = frozenset(op.name for op in static_ops)
+        static_ops, static_names = self._static_decode_ops(extra_guids)
 
-        def prefill_static(params, stats, extra):
-            env = {g: extra[f"in_{g}"] for g in extra_guids}
-            for t, val in self._constants.values():
-                fdt = jnp.int32 if "int" in t.dtype else cdtype
-                env[t.guid] = jnp.full(t.dims, val, fdt)
-            ctx = FwdCtx(training=False,
-                         rng=jax.random.key(self.config.seed),
-                         stats_in=stats)
-            for op in static_ops:
-                xs = [env[t.guid] for t in op.inputs]
-                ys = op.forward(params.get(op.param_key, {}), xs, ctx)
-                for t, y in zip(op.outputs, ys):
-                    env[t.guid] = y
-            return env
-
-        def step(params, stats, extra, pre_env, temp, carry, inp):
+        def step(params, stats, pre_env, temp, carry, inp):
             caches, tok, pos, key = carry
             feed_tok, use_feed = inp
             cur = jnp.where(use_feed, feed_tok, tok)          # (B,)
-            batch = {f"in_{tok_t.guid}": cur[:, None], **extra}
+            batch = {f"in_{tok_t.guid}": cur[:, None]}
             if pos_t is not None:
                 batch[f"in_{pos_t.guid}"] = jnp.full((B, 1), pos, jnp.int32)
             ctx = FwdCtx(training=False,
@@ -1377,14 +1386,14 @@ class FFModel:
         if run is None:
             @jax.jit
             def run(params, stats, extra, feed, use, key0, temp):
-                pre_env = prefill_static(params, stats, extra)
+                pre_env = self._prefill_static(params, stats, extra,
+                                               extra_guids, static_ops)
                 caches0 = {op.name: op.init_cache(B, s_max, cdtype)
                            for op in self.ops if op.name not in static_names}
                 carry0 = (caches0, jnp.zeros((B,), jnp.int32),
                           jnp.zeros((), jnp.int32), key0)
                 _, outs = jax.lax.scan(
-                    lambda c, i: step(params, stats, extra, pre_env, temp,
-                                      c, i),
+                    lambda c, i: step(params, stats, pre_env, temp, c, i),
                     carry0, (feed, use))
                 return outs                                   # (P+N-1, B)
 
@@ -1398,6 +1407,136 @@ class FFModel:
                    jax.random.key(seed),
                    jnp.asarray(float(temperature), jnp.float32))
         return np.asarray(outs[P - 1:].T)                     # (B, N)
+
+    def beam_search(self, prompt_tokens, max_new_tokens: int, *,
+                    beam_size: int = 4,
+                    tokens_input: Optional[Tensor] = None,
+                    positions_input: Optional[Tensor] = None,
+                    extra_inputs: Optional[Dict[Tensor, Any]] = None,
+                    eos_id: Optional[int] = None):
+        """Beam-search decoding: returns (sequences (B, K, N) int32,
+        scores (B, K) float32 — summed token log-probs, best first).
+
+        Beams ride the batch dim (B*K rows through the same kv-cached
+        decode graph as ``generate``); at each step candidate scores
+        expand to (B, K*V), the top K survive, and every cache leaf is
+        gathered by the surviving beams' parent indices — all inside one
+        jitted ``lax.scan``.  A finished beam (``eos_id`` emitted) is
+        frozen by forcing its next-token distribution to eos at
+        log-prob 0.
+        """
+        assert self._compiled, "call compile() first"
+        toks = jnp.asarray(prompt_tokens, jnp.int32)
+        B, P = toks.shape
+        N = int(max_new_tokens)
+        K = int(beam_size)
+        if N <= 0:
+            return (np.zeros((B, K, 0), np.int32),
+                    np.zeros((B, K), np.float32))
+        tok_t = tokens_input or self.input_tensors[0]
+        pos_t = positions_input
+        if pos_t is None and tokens_input is None \
+                and len(self.input_tensors) > 1:
+            pos_t = self.input_tensors[1]
+        s_max = P + N
+        self._check_position_table(pos_t, s_max)
+        BK = B * K
+        cdtype = self.compute_dtype
+        final_guid = self.final_tensor().guid
+
+        extra_guids = {t.guid for t in (extra_inputs or {})}
+        static_ops, static_names = self._static_decode_ops(extra_guids)
+
+        def step(params, stats, pre_env, carry, inp):
+            caches, buf, scores, last, pos = carry
+            feed_tok, use_feed, do_expand = inp           # (B,), scalars
+            cur = jnp.where(use_feed,
+                            jnp.repeat(feed_tok, K), last)    # (BK,)
+            batch = {f"in_{tok_t.guid}": cur[:, None]}
+            if pos_t is not None:
+                batch[f"in_{pos_t.guid}"] = jnp.full((BK, 1), pos,
+                                                     jnp.int32)
+            ctx = FwdCtx(training=False,
+                         rng=jax.random.key(self.config.seed),
+                         stats_in=stats)
+            env, caches = self._run_graph_decode(params, caches, batch,
+                                                 pos, ctx, pre_env=pre_env,
+                                                 skip=static_names)
+            probs = env[final_guid][:, -1, :].astype(jnp.float32)
+            logp = jnp.log(probs + 1e-30)                  # (BK, V)
+            V = logp.shape[-1]
+            if eos_id is not None:
+                # freeze on the token at THIS position (cur) — the carry
+                # `last` is one token stale at the first expand step
+                fin = (cur == eos_id)[:, None]
+                frozen = jnp.full((1, V), -jnp.inf).at[0, eos_id].set(0.0)
+                logp = jnp.where(fin, frozen, logp)
+
+            def expand(args):
+                caches, buf, scores, _ = args
+                total = scores.reshape(B, K, 1) + logp.reshape(B, K, V)
+                top, idx = jax.lax.top_k(total.reshape(B, K * V), K)
+                parent = idx // V                          # (B, K)
+                token = (idx % V).astype(jnp.int32)
+                flat = (parent + jnp.arange(B)[:, None] * K).reshape(-1)
+                caches = jax.tree.map(lambda c: c[flat], caches)
+                buf = buf[flat]
+                widx = jnp.clip(pos - (P - 1), 0, N - 1)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, token.reshape(BK, 1), (0, widx))
+                return caches, buf, top, token.reshape(-1)
+
+            def passthrough(args):
+                caches, buf, scores, _ = args
+                return caches, buf, scores, cur
+
+            caches, buf, scores, last = jax.lax.cond(
+                do_expand, expand, passthrough, (caches, buf, scores, cur))
+            return (caches, buf, scores, last, pos + 1), None
+
+        extra = {f"in_{t.guid}": jnp.asarray(v)
+                 for t, v in (extra_inputs or {}).items()}
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        ckey = ("beam", B, P, N, K, eos_id, tok_t.guid,
+                pos_t.guid if pos_t is not None else None,
+                tuple(sorted((k, v.shape) for k, v in extra.items())))
+        run = cache.get(ckey)
+        if run is None:
+            @jax.jit
+            def run(params, stats, extra, feed, use):
+                pre_env = self._prefill_static(params, stats, extra,
+                                               extra_guids, static_ops,
+                                               repeat=K)
+                caches0 = {op.name: op.init_cache(BK, s_max, cdtype)
+                           for op in self.ops if op.name not in static_names}
+                # beams 1..K-1 start at -inf so the first free step
+                # expands from beam 0 alone
+                scores0 = jnp.tile(
+                    jnp.concatenate([jnp.zeros((1,)),
+                                     jnp.full((K - 1,), -jnp.inf)])[None],
+                    (B, 1)).astype(jnp.float32)
+                carry0 = (caches0, jnp.zeros((BK, N), jnp.int32), scores0,
+                          jnp.zeros((BK,), jnp.int32),
+                          jnp.zeros((), jnp.int32))
+                # T = P+N-1 steps: positions 0..P-2 feed the prompt;
+                # positions P-1..P+N-2 expand (N beam updates)
+                (caches, buf, scores, last, _), _ = jax.lax.scan(
+                    lambda c, i: step(params, stats, pre_env, c, i),
+                    carry0, (feed, use, do_exp))
+                return buf.reshape(B, K, N), scores
+
+            cache[ckey] = run
+
+        feed = jnp.concatenate(
+            [toks.T, jnp.zeros((N - 1, B), jnp.int32)]) if N > 1 else toks.T
+        use = jnp.concatenate([jnp.ones((P,), bool),
+                               jnp.zeros((N - 1,), bool)])
+        do_exp = jnp.concatenate([jnp.zeros((P - 1,), bool),
+                                  jnp.ones((N,), bool)])
+        seqs, scores = run(self._params, self._stats, extra, feed, use)
+        return np.asarray(seqs), np.asarray(scores)
 
     # ------------------------------------------------------------------
     # metrics (reference: UPDATE_METRICS_TASK fold, model.cc:1145-1167)

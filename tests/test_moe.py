@@ -124,9 +124,10 @@ def test_capacity_and_dropped_tokens():
 
 
 def test_moe_transformer_generate(devices):
-    """generate() through Switch-MoE blocks (ExpertMLP decodes via its
-    stateless forward); greedy output pinned to the full-forward oracle
-    at a size where expert capacity drops nothing."""
+    """generate() through Switch-MoE blocks (ExpertMLP.decode routes
+    droplessly — the training-time capacity cut would corrupt decode
+    batches); greedy output pinned to the full-forward oracle at a size
+    where the oracle's capacity also drops nothing."""
     import jax.numpy as jnp
 
     from flexflow_tpu.models.transformer import build_transformer
