@@ -175,6 +175,25 @@ def main():
         except Exception as e:
             extra["transformer"] = {"error": f"{type(e).__name__}: {e}"}
         try:
+            # kv-cached decode throughput on-chip: one jitted scan.  A
+            # 1-token prompt makes every timed step a decode step, so
+            # tokens/s is the pure per-token rate (no prefill share).
+            import numpy as _np
+
+            model_t = _build("transformer", 16, "bfloat16")
+            rng_d = _np.random.default_rng(0)
+            prompt = rng_d.integers(0, TRANSFORMER_VOCAB,
+                                    size=(16, 1)).astype(_np.int32)
+            model_t.generate(prompt, 64)      # compile + warmup
+            t0 = time.perf_counter()
+            model_t.generate(prompt, 64)
+            dt_d = time.perf_counter() - t0
+            extra["decode"] = {
+                "tokens_per_sec": round(16 * 64 / dt_d, 1),
+                "batch": 16, "new_tokens": 64}
+        except Exception as e:
+            extra["decode"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
             # fused Pallas optimizer kernels on the real chip (single
             # device): proves they compile+run outside interpret mode
             sps_f, _, _ = run_one("alexnet", batch_size=256, steps=8,

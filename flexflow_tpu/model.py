@@ -1413,7 +1413,8 @@ class FFModel:
                     tokens_input: Optional[Tensor] = None,
                     positions_input: Optional[Tensor] = None,
                     extra_inputs: Optional[Dict[Tensor, Any]] = None,
-                    eos_id: Optional[int] = None):
+                    eos_id: Optional[int] = None,
+                    length_penalty: float = 0.0):
         """Beam-search decoding: returns (sequences (B, K, N) int32,
         scores (B, K) float32 — summed token log-probs, best first).
 
@@ -1423,7 +1424,10 @@ class FFModel:
         gathered by the surviving beams' parent indices — all inside one
         jitted ``lax.scan``.  A finished beam (``eos_id`` emitted) is
         frozen by forcing its next-token distribution to eos at
-        log-prob 0.
+        log-prob 0.  ``length_penalty`` alpha > 0 re-ranks the final
+        beams by the GNMT normalization score/((5+len)/6)^alpha (len =
+        tokens up to and including eos); the returned scores stay raw
+        log-prob sums.
         """
         assert self._compiled, "call compile() first"
         toks = jnp.asarray(prompt_tokens, jnp.int32)
@@ -1536,7 +1540,19 @@ class FFModel:
         do_exp = jnp.concatenate([jnp.zeros((P - 1,), bool),
                                   jnp.ones((N,), bool)])
         seqs, scores = run(self._params, self._stats, extra, feed, use)
-        return np.asarray(seqs), np.asarray(scores)
+        seqs, scores = np.asarray(seqs), np.asarray(scores)
+        if length_penalty > 0.0:
+            if eos_id is not None:
+                hits = seqs == eos_id                      # (B, K, N)
+                lens = np.where(hits.any(-1),
+                                hits.argmax(-1) + 1, N).astype(np.float64)
+            else:
+                lens = np.full(scores.shape, float(N))
+            norm = scores / (((5.0 + lens) / 6.0) ** length_penalty)
+            order = np.argsort(-norm, axis=1)              # best first
+            seqs = np.take_along_axis(seqs, order[:, :, None], axis=1)
+            scores = np.take_along_axis(scores, order, axis=1)
+        return seqs, scores
 
     # ------------------------------------------------------------------
     # metrics (reference: UPDATE_METRICS_TASK fold, model.cc:1145-1167)

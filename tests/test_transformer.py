@@ -237,3 +237,33 @@ def test_generate_on_sharded_model(devices):
     seqs, scores = m.beam_search(prompt, 3, beam_size=2)
     assert seqs.shape == (B2, 2, 3)
     assert (np.diff(scores, axis=1) <= 1e-6).all()
+
+
+def test_beam_length_penalty_reranks(devices):
+    """length_penalty re-ranks finished-short vs long beams by the GNMT
+    normalization; raw scores stay untouched sums."""
+    from flexflow_tpu.models.transformer import build_transformer
+
+    S2, V2, B2, P2 = 12, 6, 2, 3
+    cfg = ff.FFConfig(batch_size=B2)
+    m = ff.FFModel(cfg)
+    tok, pos, _ = build_transformer(m, B2, seq_length=S2, num_layers=1,
+                                    embed_dim=16, num_heads=2,
+                                    vocab_size=V2)
+    m.compile(ff.SGDOptimizer(lr=0.01), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=3)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, V2, size=(B2, P2)).astype(np.int32)
+
+    s0, sc0 = m.beam_search(prompt, 4, beam_size=3, eos_id=0)
+    s1, sc1 = m.beam_search(prompt, 4, beam_size=3, eos_id=0,
+                            length_penalty=1.0)
+    # same beam SET per row, possibly re-ordered; normalized order holds
+    for row in range(B2):
+        assert {tuple(x) for x in s0[row]} == {tuple(x) for x in s1[row]}
+        fin = np.isfinite(sc1[row])
+        lens = np.where((s1[row] == 0).any(-1),
+                        (s1[row] == 0).argmax(-1) + 1, 4)
+        norm = sc1[row] / (((5.0 + lens) / 6.0) ** 1.0)
+        assert (np.diff(norm[fin]) <= 1e-6).all()
