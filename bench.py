@@ -191,6 +191,7 @@ def main():
             extra["decode"] = {
                 "tokens_per_sec": round(16 * 64 / dt_d, 1),
                 "batch": 16, "new_tokens": 64}
+            del model_t  # free HBM before the fused-optimizer run
         except Exception as e:
             extra["decode"] = {"error": f"{type(e).__name__}: {e}"}
         try:

@@ -1541,15 +1541,13 @@ class FFModel:
                                   jnp.ones((N,), bool)])
         seqs, scores = run(self._params, self._stats, extra, feed, use)
         seqs, scores = np.asarray(seqs), np.asarray(scores)
-        if length_penalty > 0.0:
-            if eos_id is not None:
-                hits = seqs == eos_id                      # (B, K, N)
-                lens = np.where(hits.any(-1),
-                                hits.argmax(-1) + 1, N).astype(np.float64)
-            else:
-                lens = np.full(scores.shape, float(N))
+        if length_penalty > 0.0 and eos_id is not None:
+            # without an eos all lens == N and the re-rank is a no-op
+            hits = seqs == eos_id                          # (B, K, N)
+            lens = np.where(hits.any(-1),
+                            hits.argmax(-1) + 1, N).astype(np.float64)
             norm = scores / (((5.0 + lens) / 6.0) ** length_penalty)
-            order = np.argsort(-norm, axis=1)              # best first
+            order = np.argsort(-norm, axis=1, kind="stable")  # best first
             seqs = np.take_along_axis(seqs, order[:, :, None], axis=1)
             scores = np.take_along_axis(scores, order, axis=1)
         return seqs, scores

@@ -267,3 +267,22 @@ def test_beam_length_penalty_reranks(devices):
                         (s1[row] == 0).argmax(-1) + 1, 4)
         norm = sc1[row] / (((5.0 + lens) / 6.0) ** 1.0)
         assert (np.diff(norm[fin]) <= 1e-6).all()
+
+
+def test_generate_bfloat16(devices):
+    """The bench's decode config: kv caches and activations in bf16
+    (argmax over f32-cast probs keeps token selection stable)."""
+    from flexflow_tpu.models.transformer import build_transformer
+
+    cfg = ff.FFConfig(batch_size=4, compute_dtype="bfloat16")
+    m = ff.FFModel(cfg)
+    tok, pos, _ = build_transformer(m, 4, seq_length=16, num_layers=2,
+                                    embed_dim=32, num_heads=4,
+                                    vocab_size=50)
+    m.compile(ff.SGDOptimizer(lr=0.01), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=2)
+    prompt = np.random.default_rng(0).integers(
+        0, 50, size=(4, 1)).astype(np.int32)
+    out = m.generate(prompt, 8)
+    assert out.shape == (4, 8) and (out >= 0).all() and (out < 50).all()
