@@ -1313,12 +1313,19 @@ class FFModel:
                  tokens_input: Optional[Tensor] = None,
                  positions_input: Optional[Tensor] = None,
                  extra_inputs: Optional[Dict[Tensor, Any]] = None,
-                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: int = 0) -> np.ndarray:
         """Generate ``max_new_tokens`` continuations for a (B, P) int32
         prompt with kv-cached greedy (temperature=0) or sampled
         decoding.  The whole prefill+decode loop is ONE jitted
         ``lax.scan`` over P+N-1 single-token steps — each attention op
         carries a (B, H, P+N, head_dim) cache written in place.
+
+        Sampling knobs (active only with temperature > 0): ``top_k``
+        keeps the k most likely tokens; ``top_p`` keeps the smallest
+        nucleus of tokens whose probabilities sum to >= p (the most
+        likely token always survives); both may combine.
 
         ``tokens_input``/``positions_input`` default to the model's
         first/second graph inputs (the ``build_transformer`` layout).
@@ -1345,6 +1352,14 @@ class FFModel:
         cdtype = self.compute_dtype
         final_guid = self.final_tensor().guid
         sampled = float(temperature) > 0.0
+        # normalized trace constants: inactive knobs don't fork the
+        # compile cache, bad values fail loudly
+        t_k = int(top_k) if sampled and top_k is not None else None
+        t_p = float(top_p) if sampled and top_p is not None else None
+        if t_k is not None and t_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if t_p is not None and not 0.0 < t_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
         extra_guids = {t.guid for t in (extra_inputs or {})}
         static_ops, static_names = self._static_decode_ops(extra_guids)
@@ -1364,9 +1379,23 @@ class FFModel:
                                                  skip=static_names)
             probs = env[final_guid][:, -1, :].astype(jnp.float32)  # (B, V)
             if sampled:
+                logits = jnp.log(probs + 1e-9)
+                if t_k is not None or t_p is not None:
+                    srt = jnp.sort(probs, axis=-1)[:, ::-1]       # desc
+                    if t_k is not None:
+                        kth = srt[:, min(t_k, srt.shape[1]) - 1][:, None]
+                        logits = jnp.where(probs >= kth, logits, -jnp.inf)
+                    if t_p is not None:
+                        csum = jnp.cumsum(srt, axis=-1)
+                        # smallest prefix with mass >= p; cutoff = that
+                        # prefix's lowest prob (top token always survives)
+                        keep_n = jnp.sum(csum < t_p, axis=-1)
+                        cutoff = jnp.take_along_axis(
+                            srt, keep_n[:, None], axis=-1)
+                        logits = jnp.where(probs >= cutoff, logits,
+                                           -jnp.inf)
                 key, k = jax.random.split(key)
-                nxt = jax.random.categorical(
-                    k, jnp.log(probs + 1e-9) / temp, axis=-1)
+                nxt = jax.random.categorical(k, logits / temp, axis=-1)
             else:
                 nxt = jnp.argmax(probs, axis=-1)
             nxt = nxt.astype(jnp.int32)
@@ -1379,7 +1408,7 @@ class FFModel:
             cache = self._gen_cache = {}
         # seed/temperature are runtime ARGUMENTS (key0/temp below), not
         # trace constants — new seeds reuse the compiled scan
-        ckey = (B, P, N, sampled, tok_t.guid,
+        ckey = (B, P, N, sampled, t_k, t_p, tok_t.guid,
                 pos_t.guid if pos_t is not None else None,
                 tuple(sorted((k, v.shape) for k, v in extra.items())))
         run = cache.get(ckey)

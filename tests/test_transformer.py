@@ -286,3 +286,50 @@ def test_generate_bfloat16(devices):
         0, 50, size=(4, 1)).astype(np.int32)
     out = m.generate(prompt, 8)
     assert out.shape == (4, 8) and (out >= 0).all() and (out < 50).all()
+
+
+def test_generate_top_k_top_p(devices):
+    """top_k=1 sampling equals greedy for any temperature; top_p keeps
+    sampled tokens inside the nucleus (checked against per-step
+    full-forward distributions)."""
+    from flexflow_tpu.models.transformer import build_transformer
+
+    cfg = ff.FFConfig(batch_size=4)
+    m = ff.FFModel(cfg)
+    tok, pos, _ = build_transformer(m, 4, seq_length=16, num_layers=2,
+                                    embed_dim=32, num_heads=4,
+                                    vocab_size=20)
+    m.compile(ff.SGDOptimizer(lr=0.01), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=9)
+    prompt = np.random.default_rng(5).integers(
+        0, 20, size=(4, 3)).astype(np.int32)
+
+    greedy = m.generate(prompt, 6)
+    k1 = m.generate(prompt, 6, temperature=1.7, top_k=1, seed=3)
+    np.testing.assert_array_equal(k1, greedy)
+
+    # nucleus: every sampled token must be at least as probable as the
+    # nucleus cutoff of its step's distribution
+    p = 0.5
+    out = m.generate(prompt, 6, temperature=1.0, top_p=p, seed=11)
+    import jax.numpy as jnp
+
+    seq = prompt.copy()
+    for i in range(6):
+        L = seq.shape[1]
+        tf = np.zeros((4, 16), np.int32)
+        tf[:, :L] = seq
+        posa = np.broadcast_to(np.arange(16, dtype=np.int32),
+                               (4, 16)).copy()
+        env, _ = m._run_graph(m._params, m._stats,
+                              {f"in_{tok.guid}": jnp.asarray(tf),
+                               f"in_{pos.guid}": jnp.asarray(posa)},
+                              False, None)
+        probs = np.asarray(env[m.final_tensor().guid])[:, L - 1, :]
+        for row in range(4):
+            srt = np.sort(probs[row])[::-1]
+            keep_n = int((np.cumsum(srt) < p).sum())
+            cutoff = srt[keep_n]
+            assert probs[row, out[row, i]] >= cutoff - 1e-7
+        seq = np.concatenate([seq, out[:, i:i + 1]], axis=1)
