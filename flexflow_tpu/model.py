@@ -1265,6 +1265,21 @@ class FFModel:
                 env[t.guid] = y
         return env, new_caches
 
+    def _decode_params(self):
+        """Params tree for decoding: a pipelined model's packed stage
+        weights unpack to per-op entries (the decode runner walks ops
+        sequentially, not the GPipe ring).  Cached until a train step or
+        restore replaces ``_params``."""
+        if self._pipe_pack() is None:
+            return self._params
+        cached = getattr(self, "_dp_cache", None)
+        if cached is not None and cached[0] is self._params:
+            return cached[1]
+        from .runtime.checkpoint import _unpack_tree
+        tree = _unpack_tree(self, self._params)
+        self._dp_cache = (self._params, tree)
+        return tree
+
     def _check_position_table(self, pos_t, s_max: int) -> None:
         """jnp.take clamps OOB position lookups under jit — catch an
         overlong request instead of degrading silently."""
@@ -1352,14 +1367,15 @@ class FFModel:
         cdtype = self.compute_dtype
         final_guid = self.final_tensor().guid
         sampled = float(temperature) > 0.0
-        # normalized trace constants: inactive knobs don't fork the
-        # compile cache, bad values fail loudly
+        # bad knob values fail loudly even when greedy ignores them ...
+        if top_k is not None and int(top_k) < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # ... then normalize to trace constants: inactive knobs don't
+        # fork the compile cache
         t_k = int(top_k) if sampled and top_k is not None else None
         t_p = float(top_p) if sampled and top_p is not None else None
-        if t_k is not None and t_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        if t_p is not None and not 0.0 < t_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
         extra_guids = {t.guid for t in (extra_inputs or {})}
         static_ops, static_names = self._static_decode_ops(extra_guids)
@@ -1388,8 +1404,11 @@ class FFModel:
                     if t_p is not None:
                         csum = jnp.cumsum(srt, axis=-1)
                         # smallest prefix with mass >= p; cutoff = that
-                        # prefix's lowest prob (top token always survives)
-                        keep_n = jnp.sum(csum < t_p, axis=-1)
+                        # prefix's lowest prob (top token always
+                        # survives).  Clamp: with p=1.0 a float32 row
+                        # summing just under 1.0 would index past V
+                        keep_n = jnp.minimum(jnp.sum(csum < t_p, axis=-1),
+                                             srt.shape[1] - 1)
                         cutoff = jnp.take_along_axis(
                             srt, keep_n[:, None], axis=-1)
                         logits = jnp.where(probs >= cutoff, logits,
@@ -1432,7 +1451,7 @@ class FFModel:
             [toks.T, jnp.zeros((N - 1, B), jnp.int32)]) if N > 1 else toks.T
         use = jnp.concatenate([jnp.ones((P,), bool),
                                jnp.zeros((N - 1,), bool)])
-        outs = run(self._params, self._stats, extra, feed, use,
+        outs = run(self._decode_params(), self._stats, extra, feed, use,
                    jax.random.key(seed),
                    jnp.asarray(float(temperature), jnp.float32))
         return np.asarray(outs[P - 1:].T)                     # (B, N)
@@ -1568,7 +1587,7 @@ class FFModel:
                                jnp.zeros((N - 1,), bool)])
         do_exp = jnp.concatenate([jnp.zeros((P - 1,), bool),
                                   jnp.ones((N,), bool)])
-        seqs, scores = run(self._params, self._stats, extra, feed, use)
+        seqs, scores = run(self._decode_params(), self._stats, extra, feed, use)
         seqs, scores = np.asarray(seqs), np.asarray(scores)
         if length_penalty > 0.0 and eos_id is not None:
             # without an eos all lens == N and the re-rank is a no-op
