@@ -4,6 +4,7 @@ Reference defaults: bs=64/worker, 2 layers, seq 20, hidden=embed=2048,
 vocab 20k; times 10 iterations and prints wall-clock.
 
     python examples/nmt.py -b 64 --bf16 [--seq 20 --hidden 2048 --vocab 20480]
+                                        [--translate]
 """
 
 import sys
@@ -23,6 +24,7 @@ def main(argv=None):
     cfg = ff.FFConfig(batch_size=64)
     rest = cfg.parse_args(argv)
     seq, hidden, embed, vocab, layers, iters = 20, 2048, 2048, 20 * 1024, 2, 10
+    translate = False
     i = 0
     while i < len(rest):
         if rest[i] == "--seq":
@@ -37,6 +39,8 @@ def main(argv=None):
             i += 1; layers = int(rest[i])
         elif rest[i] == "--iters":
             i += 1; iters = int(rest[i])
+        elif rest[i] == "--translate":
+            translate = True
         i += 1
 
     model = ff.FFModel(cfg)
@@ -63,6 +67,17 @@ def main(argv=None):
     tokens = iters * cfg.batch_size * seq
     print(f"time = {run_time:.4f}s ({tokens / run_time:.0f} tokens/s, "
           f"{iters * cfg.batch_size / run_time:.1f} samples/s)")
+
+    if translate:
+        # greedy seq2seq decoding demo (beyond the training-only
+        # reference): encode the source batch once, step the decoder
+        from flexflow_tpu.models.nmt import greedy_translate
+
+        t0 = time.perf_counter()
+        out = greedy_translate(model, src, dst, s, seq, bos_id=1)
+        dt = time.perf_counter() - t0
+        print(f"translate: {out.shape[0]}x{out.shape[1]} tokens in "
+              f"{dt:.2f}s; first row: {out[0, :10].tolist()}...")
     return run_time
 
 

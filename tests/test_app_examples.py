@@ -13,7 +13,7 @@ def test_nmt_driver():
     from examples.nmt import main
 
     main(["-b", "8", "--seq", "6", "--hidden", "32", "--embed", "32",
-          "--vocab", "64", "--layers", "1", "--iters", "2"])
+          "--vocab", "64", "--layers", "1", "--iters", "2", "--translate"])
 
 
 def test_dlrm_driver():
