@@ -1,0 +1,145 @@
+"""Environment doctor: one command to sanity-check an install.
+
+    python -m flexflow_tpu.tools.doctor [--skip-accelerator]
+
+Reports versions, backend/devices (with a watchdog — a wedged remote-TPU
+tunnel hangs any device op forever, a failure mode this tool must
+survive), native-library availability, and runs a tiny CPU-mesh
+training loop end to end.  Exit code 0 iff every required check passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import os
+import sys
+from typing import List, Optional, Tuple
+
+
+def _check(name: str, fn, required: bool = True) -> Tuple[str, str, str]:
+    try:
+        detail = fn()
+        return name, "ok", str(detail)
+    except Exception as e:  # noqa: BLE001 — report, don't crash the doctor
+        return (name, "FAIL" if required else "warn",
+                f"{type(e).__name__}: {e}")
+
+
+def _versions():
+    import jax
+    import numpy as np
+
+    return f"python {sys.version.split()[0]}, jax {jax.__version__}, numpy {np.__version__}"
+
+
+def _accelerator():
+    # A SUBPROCESS with a kill timeout: a wedged remote-TPU tunnel hangs
+    # inside a C call, where an in-process SIGALRM handler can never run
+    # (CPython delivers signals only between bytecodes).
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((128, 128), jnp.float32);"
+            "s = float(jax.device_get((x @ x).sum()));"
+            "d = jax.devices();"
+            "print(len(d), d[0].device_kind.replace(' ', '_'), s)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=90)
+    except subprocess.TimeoutExpired:
+        raise TimeoutError("no response in 90s — backend unresponsive "
+                           "(remote tunnel wedged?)")
+    if r.returncode != 0:
+        raise RuntimeError(r.stderr.strip().splitlines()[-1]
+                           if r.stderr.strip() else f"rc={r.returncode}")
+    n, kind, s = r.stdout.split()[-3:]
+    assert float(s) == 128.0 * 128 * 128, s
+    return f"{n} device(s), [0]={kind}, matmul ok"
+
+
+def _native_libs():
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = []
+    for lib in ("libffsearch.so", "libffsim.so", "libffdata.so",
+                "libflexflow_c.so"):
+        p = os.path.join(here, "native", lib)
+        if os.path.exists(p):
+            if lib != "libflexflow_c.so":  # embeds CPython; don't dlopen here
+                ctypes.CDLL(p)
+            found.append(lib)
+    return f"{len(found)}/4 built ({', '.join(found) or 'none'} — optional)"
+
+
+def _optional_deps():
+    mods = []
+    for m in ("orbax.checkpoint", "torch", "flax", "optax"):
+        try:
+            __import__(m)
+            mods.append(m.split(".")[0])
+        except ImportError:
+            pass
+    return ", ".join(mods) or "none"
+
+
+def _cpu_train():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=16)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 8), nchw=False, name="x")
+    t = m.dense(inp, 32, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.5), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 8), dtype=np.float32)
+    y = np.argmax(x[:, :4], 1).astype(np.int32)[:, None]
+    losses = []
+    for _ in range(20):
+        m.set_batch({inp: x}, y)
+        m.train_iteration()
+        m.sync()
+        m.get_metrics()
+        losses.append(m.last_loss)
+        m.reset_metrics()
+    assert losses[-1] < losses[0] * 0.5, f"loss did not drop: {losses}"
+    return f"loss {losses[0]:.3f} -> {losses[-1]:.3f} in 20 steps"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--skip-accelerator", action="store_true",
+                   help="skip the default-backend device probe (e.g. in "
+                        "CPU-only CI, or when the TPU tunnel is known bad)")
+    args = p.parse_args(argv)
+
+    plan = [("versions", _versions, True)]
+    if not args.skip_accelerator:
+        plan.append(("accelerator", _accelerator, False))
+    plan += [("native libs", _native_libs, False),
+             ("optional deps", _optional_deps, False),
+             ("cpu training", _cpu_train, True)]
+
+    # print each line as its check completes — the slow checks (90s
+    # wedged-tunnel probe, the training loop) must show live progress
+    width = max(len(n) for n, _, _ in plan)
+    failed = False
+    for name, fn, required in plan:
+        _, status, detail = _check(name, fn, required)
+        print(f"[{status:<4}] {name:<{width}}  {detail}", flush=True)
+        failed |= status == "FAIL"
+    print("doctor:", "FAIL" if failed else "all required checks passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,3 +88,10 @@ def test_module_runner_executes_script(tmp_path):
         cwd=root, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-500:]
     assert "RUNNER_OK" in r.stdout
+
+
+def test_doctor_cli(devices):
+    """The install doctor passes on a healthy CPU environment."""
+    from flexflow_tpu.tools.doctor import main
+
+    assert main(["--skip-accelerator"]) == 0
