@@ -127,3 +127,29 @@ def test_import_export_strategy_file(devices, tmp_path):
     m2.softmax(t2, name="softmax1")
     m2.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy", ["accuracy"])
     assert m2.ops[0].pc.dims == (2, 2)
+
+
+def test_rank_mismatched_strategy_degrades_to_dp(devices):
+    """find_parallel_config with a wrong-rank entry falls back to data
+    parallelism instead of asserting (reference: strategy.cc:28-85
+    asserts; we degrade — SURVEY §2.1 mapper semantics)."""
+    cfg = ff.FFConfig(batch_size=16, workers_per_node=8)
+    # a 4-D conv-style config attached to a 2-D dense op: wrong rank
+    cfg.strategies["fc1"] = ff.ParallelConfig(dims=(2, 2, 1, 1))
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 8), nchw=False)
+    t = m.dense(inp, 16, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    fc1 = next(op for op in m.ops if op.name == "fc1")
+    assert fc1.pc.ndims == 2           # degraded to the op's rank
+    assert fc1.pc.dims[0] == 8         # full data parallelism
+    m.init_layers(seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 8), dtype=np.float32)
+    y = rng.integers(0, 4, size=(16, 1), dtype=np.int32)
+    m.set_batch({inp: x}, y)
+    m.train_iteration()
+    m.sync()
