@@ -34,7 +34,8 @@ from .model import FFModel
 from .ops.base import Op
 from .ops.conv2d import ActiMode, PoolType
 from .ops.embedding import AggrMode
-from .optimizers import AdamOptimizer, Optimizer, SGDOptimizer
+from .optimizers import (AdamOptimizer, OptaxOptimizer, Optimizer,
+                         SGDOptimizer)
 from .parallel.mesh import Machine
 from .parallel.strategy import load_strategies_from_file, save_strategies_to_file
 from .runtime.dataloader import DataLoader
@@ -47,7 +48,7 @@ __all__ = [
     "DataLoader", "DataType", "DeviceType", "FFConfig", "FFModel",
     "GlorotUniform", "Loss", "LossType", "Machine", "MetricsType",
     "NormInitializer", "Op", "Optimizer", "Parameter", "ParallelConfig",
-    "PerfMetrics", "PoolType", "SGDOptimizer", "Tensor",
+    "OptaxOptimizer", "PerfMetrics", "PoolType", "SGDOptimizer", "Tensor",
     "UniformInitializer", "ZeroInitializer", "load_strategies_from_file",
     "save_strategies_to_file",
 ]

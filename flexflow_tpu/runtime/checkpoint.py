@@ -170,6 +170,8 @@ def _load_npz(model, path: str) -> None:
             return {k: rebuild(v, f"{prefix}{k}/") for k, v in template.items()}
         if isinstance(template, (list, tuple)):
             vals = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(template)]
+            if hasattr(template, "_fields"):  # NamedTuple (optax states)
+                return type(template)(*vals)
             return type(template)(vals)
         return data[prefix[:-1]]
 
@@ -194,11 +196,23 @@ def _load_npz(model, path: str) -> None:
     state["params"] = place_params_like(state["params"])
     if "opt_state" in state and isinstance(state["opt_state"], dict):
         # optimizer slots re-take their param's sharding — or the ZeRO-1
-        # layout when the optimizer carries zero_specs
+        # layout when the optimizer carries zero_specs; non-dict slots
+        # (optax NamedTuple states) re-place replicated on the mesh so
+        # the restored step doesn't mix host numpy with mesh arrays
         zs = getattr(model.optimizer, "zero_specs", None) \
             if model.optimizer is not None else None
+
+        def place_other(v):
+            if model.machine is None or model.machine.num_devices <= 1:
+                return v
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(model.machine.mesh, PartitionSpec())
+            return jax.tree.map(lambda a: jax.device_put(a, rep), v)
+
         state["opt_state"] = {
-            k: (place_params_like(v, zs) if isinstance(v, dict) else v)
+            k: (place_params_like(v, zs) if isinstance(v, dict)
+                else place_other(v))
             for k, v in state["opt_state"].items()}
     _apply_tree(model, state)
 
