@@ -7,4 +7,4 @@ from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
                      Input, MaxPooling2D, Multiply, Subtract)
 from .models import Model, Sequential
-from .optimizers import SGD, Adam
+from .optimizers import SGD, Adam, Optax

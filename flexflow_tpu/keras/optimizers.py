@@ -51,3 +51,24 @@ class Adam(Optimizer):
         self.learning_rate = lr
         if self._core is not None:
             self._core.alpha = lr
+
+
+class Optax(Optimizer):
+    """Any optax GradientTransformation behind the keras compile()
+    surface: ``model.compile(Optax(optax.adamw(3e-4)), ...)``."""
+
+    def __init__(self, tx):
+        self.tx = tx
+
+    def to_core(self):
+        from ..optimizers import OptaxOptimizer
+
+        return OptaxOptimizer(self.tx)
+
+    def set_learning_rate(self, lr: float):
+        # LearningRateScheduler calls this unconditionally; an optax
+        # chain's lr is baked into the transformation
+        raise ValueError(
+            "Optax optimizers take their schedule from the optax chain "
+            "(e.g. optax.adamw(optax.cosine_decay_schedule(...))) — "
+            "LearningRateScheduler cannot mutate it")

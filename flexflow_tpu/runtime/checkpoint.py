@@ -87,14 +87,40 @@ def _repack_tree(model, canonical: Dict[str, Any], like: Dict[str, Any]) -> Dict
     return out
 
 
+def _map_slot_dicts(v, f):
+    """Apply f to each params-shaped dict NODE inside an optimizer slot
+    (optax states nest them inside NamedTuples/tuples)."""
+    if isinstance(v, dict):
+        return f(v)
+    if isinstance(v, tuple):
+        vals = [_map_slot_dicts(x, f) for x in v]
+        return type(v)(*vals) if hasattr(v, "_fields") else type(v)(vals)
+    if isinstance(v, list):
+        return [_map_slot_dicts(x, f) for x in v]
+    return v
+
+
+def _map_slot_dicts2(v, like, f):
+    """Two-tree variant: descend v and like in parallel (same outer
+    structure; the dict nodes may differ — canonical vs packed)."""
+    if isinstance(v, dict):
+        return f(v, like)
+    if isinstance(v, tuple):
+        vals = [_map_slot_dicts2(x, l, f) for x, l in zip(v, like)]
+        return type(v)(*vals) if hasattr(v, "_fields") else type(v)(vals)
+    if isinstance(v, list):
+        return [_map_slot_dicts2(x, l, f) for x, l in zip(v, like)]
+    return v
+
+
 def _tree_from_model(model) -> Dict[str, Any]:
-    state = {"params": _unpack_tree(model, model._params),
+    unpack = lambda d: _unpack_tree(model, d)
+    state = {"params": unpack(model._params),
              "stats": model._stats,
              "step": np.full((), model._step_count, np.int64)}
     if model._opt_state is not None:
-        state["opt_state"] = {
-            k: (_unpack_tree(model, v) if isinstance(v, dict) else v)
-            for k, v in model._opt_state.items()}
+        state["opt_state"] = {k: _map_slot_dicts(v, unpack)
+                              for k, v in model._opt_state.items()}
     return state
 
 
@@ -104,9 +130,11 @@ def _apply_tree(model, state: Dict[str, Any]) -> None:
     model._step_count = int(state.get("step", 0))
     if "opt_state" in state and state["opt_state"]:
         cur = model._opt_state or {}
+        repack = lambda d, like: _repack_tree(model, d, like)
         model._opt_state = {
-            k: (_repack_tree(model, v, cur.get(k))
-                if isinstance(v, dict) else v)
+            k: (_map_slot_dicts2(v, cur[k], repack) if k in cur
+                else _map_slot_dicts(v, lambda d: _repack_tree(
+                    model, d, None)))
             for k, v in state["opt_state"].items()}
 
 
@@ -202,9 +230,22 @@ def _load_npz(model, path: str) -> None:
         zs = getattr(model.optimizer, "zero_specs", None) \
             if model.optimizer is not None else None
 
-        def place_other(v):
+        def place_other(v, key):
+            # non-dict (optax NamedTuple) slots: take each leaf's
+            # sharding from a freshly-initialized state TEMPLATE so
+            # param-shaped moments come back sharded like their params
+            # (blanket replication would gather model-parallel slots)
             if model.machine is None or model.machine.num_devices <= 1:
                 return v
+            if model.optimizer is not None:
+                try:
+                    tmpl = model.optimizer.init_state(model._params).get(key)
+                    return jax.tree.map(
+                        lambda a, t: (jax.device_put(a, t.sharding)
+                                      if hasattr(t, "sharding") else a),
+                        v, tmpl)
+                except Exception:
+                    pass  # structure mismatch — replicate below
             from jax.sharding import NamedSharding, PartitionSpec
 
             rep = NamedSharding(model.machine.mesh, PartitionSpec())
@@ -212,7 +253,7 @@ def _load_npz(model, path: str) -> None:
 
         state["opt_state"] = {
             k: (place_params_like(v, zs) if isinstance(v, dict)
-                else place_other(v))
+                else place_other(v, k))
             for k, v in state["opt_state"].items()}
     _apply_tree(model, state)
 

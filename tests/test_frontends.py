@@ -315,3 +315,20 @@ def test_keras_sequential_recompile_after_add(devices):
     x = rng.standard_normal((16, 4), dtype=np.float32)
     y = (x[:, 0] > 0).astype(np.int32)
     model.fit(x, y, epochs=2, verbose=False)
+
+
+def test_keras_optax_optimizer(devices):
+    """keras.Optax(optax chain) trains through the keras fit loop."""
+    import optax
+
+    model = keras.Sequential([
+        keras.Dense(32, input_shape=(8,), activation="relu"),
+        keras.Dense(4, activation="softmax"),
+    ], config=FFConfig(batch_size=32))
+    model.compile(keras.Optax(optax.adamw(5e-3)),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 8), dtype=np.float32)
+    y = np.argmax(x[:, :4], axis=1).astype(np.int32)
+    model.fit(x, y, epochs=20, verbose=False,
+              callbacks=[keras.VerifyMetrics(0.85)])

@@ -145,3 +145,57 @@ def test_optax_adamw_trains_and_checkpoints(devices, tmp_path):
     m3.set_batch({inp3: x}, y)
     m3.train_iteration()
     m3.sync()
+
+
+def test_optax_pipelined_checkpoint_portability(devices, tmp_path):
+    """optax slot states nest params-shaped dicts inside NamedTuples;
+    a pipelined model's packed '_pipe' buffer inside those nodes must
+    canonicalize on save and repack on restore — including restoring
+    into a PLAIN model (layout portability)."""
+    import optax
+
+    def build(pipeline):
+        cfg = ff.FFConfig(batch_size=16)
+        m = ff.FFModel(cfg)
+        inp = m.create_tensor((16, 16), nchw=False, name="x")
+        t = m.dense(inp, 32, activation="relu", name="fc1")
+        t = m.dense(t, 24, activation="relu", name="fc2")
+        t = m.dense(t, 4, name="fc3")
+        m.softmax(t, name="sm")
+        if pipeline:
+            m.set_pipeline(num_stages=2, num_microbatches=4, dp_degree=2)
+        m.compile(ff.OptaxOptimizer(optax.adamw(1e-2)),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+        m.init_layers(seed=6)
+        return m, inp
+
+    m, inp = build(True)
+    if m._pipe_pack() is None:
+        import pytest
+        pytest.skip("pipeline not expressible on this mesh")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 16), dtype=np.float32)
+    y = rng.integers(0, 4, size=(16, 1), dtype=np.int32)
+    m.set_batch({inp: x}, y)
+    m.train_iteration()
+    m.sync()
+    p = str(tmp_path / "ckpt.npz")
+    m.save(p)
+
+    # packed -> packed
+    m2, inp2 = build(True)
+    m2.load(p)
+    np.testing.assert_allclose(m.get_parameter("fc2", "kernel"),
+                               m2.get_parameter("fc2", "kernel"), rtol=1e-6)
+    m2.set_batch({inp2: x}, y)
+    m2.train_iteration()
+    m2.sync()
+
+    # packed -> plain (canonical slot layout restores anywhere)
+    m3, inp3 = build(False)
+    m3.load(p)
+    np.testing.assert_allclose(m.get_parameter("fc2", "kernel"),
+                               m3.get_parameter("fc2", "kernel"), rtol=1e-6)
+    m3.set_batch({inp3: x}, y)
+    m3.train_iteration()
+    m3.sync()
