@@ -333,3 +333,25 @@ def test_generate_top_k_top_p(devices):
             cutoff = srt[keep_n]
             assert probs[row, out[row, i]] >= cutoff - 1e-7
         seq = np.concatenate([seq, out[:, i:i + 1]], axis=1)
+
+
+def test_generate_compile_cache_reuse(devices):
+    """New seeds/temperatures reuse the compiled decode scan (seed and
+    temp are runtime arguments, not trace constants)."""
+    from flexflow_tpu.models.transformer import build_transformer
+
+    cfg = ff.FFConfig(batch_size=4)
+    m = ff.FFModel(cfg)
+    tok, pos, _ = build_transformer(m, 4, seq_length=16, num_layers=1,
+                                    embed_dim=16, num_heads=2,
+                                    vocab_size=20)
+    m.compile(ff.SGDOptimizer(lr=0.01), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    m.init_layers(seed=1)
+    prompt = np.random.default_rng(0).integers(
+        0, 20, size=(4, 2)).astype(np.int32)
+    for seed in range(3):
+        m.generate(prompt, 3, temperature=0.7 + 0.1 * seed, seed=seed)
+    assert len(m._gen_cache) == 1  # one sampled-scan executable
+    m.generate(prompt, 3)          # greedy variant adds exactly one more
+    assert len(m._gen_cache) == 2
