@@ -6,6 +6,8 @@
 set -e
 cd "$(dirname "$0")"
 
+python -m flexflow_tpu.tools.doctor --skip-accelerator
+
 python -m pytest tests/ -q "$@"
 
 if [ -n "$RUN_EXAMPLES" ]; then
