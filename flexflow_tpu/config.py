@@ -159,6 +159,13 @@ class FFConfig:
     # state drops to ~1/N per device.  Beyond the reference (SURVEY §2.3
     # lists ZeRO-style optimizer sharding as design headroom).
     zero_optimizer: bool = False
+    # Row-sparse host-resident embedding tables for host-placed Embedding
+    # ops (reference: embedding.cc CPU tasks + dlrm_strategy_hetero.cc):
+    # per step only the batch's unique rows move host<->device.  None =
+    # auto (on exactly when sparse == dense numerics: plain SGD); True
+    # forces lazy per-touched-row updates under momentum/Adam; False
+    # always streams the full table.
+    sparse_host_embeddings: Optional[bool] = None
     # Per-op strategies, keyed by op name (the reference keys an equivalent
     # map by hash(op name) — include/config.h:102, strategy.cc:23-26; the
     # hash is an implementation detail of Legion mapper tags that the TPU

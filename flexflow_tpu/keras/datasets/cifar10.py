@@ -13,7 +13,7 @@ import pickle
 
 import numpy as np
 
-from ..utils.data_utils import locate_file
+from ..utils.data_utils import locate_file, warn_synthetic
 
 
 def _load_batch(fpath, label_key="labels"):
@@ -58,4 +58,5 @@ def load_data():
         x_test, y_test = _load_batch(os.path.join(dirname, "test_batch"))
         y_test = np.array(y_test, dtype="uint8")
         return (x_train, y_train.reshape(-1, 1)), (x_test, y_test.reshape(-1, 1))
+    warn_synthetic("cifar-10-batches-py")
     return _synthetic()

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..utils.data_utils import locate_file
+from ..utils.data_utils import locate_file, warn_synthetic
 
 
 def _synthetic(n_train=60000, n_test=10000, seed=113):
@@ -39,4 +39,5 @@ def load_data(path="mnist.npz"):
     if local:
         with np.load(local, allow_pickle=True) as f:
             return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+    warn_synthetic("mnist.npz")
     return _synthetic()

@@ -11,7 +11,7 @@ import json
 
 import numpy as np
 
-from ..utils.data_utils import locate_file
+from ..utils.data_utils import locate_file, warn_synthetic
 
 
 def _synthetic(n=11228, num_topics=46, seed=113):
@@ -43,6 +43,7 @@ def load_data(path="reuters.npz", num_words=None, skip_top=0, maxlen=None,
             xs, labels = f["x"], f["y"]
         xs = [list(x) for x in xs]
     else:
+        warn_synthetic("reuters.npz")
         xs, labels = _synthetic(seed=seed)
 
     rng = np.random.RandomState(seed)

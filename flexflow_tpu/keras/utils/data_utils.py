@@ -32,6 +32,25 @@ def locate_file(fname: str) -> Optional[str]:
     return None
 
 
+_warned: set = set()
+
+
+def warn_synthetic(name: str) -> None:
+    """LOUD one-line notice that a dataset loader substituted synthetic
+    data (once per dataset per process).  Every accuracy threshold met
+    on a synthetic stand-in proves learning on synthetic patterns only —
+    drop the real file in ~/.keras/datasets (or $FF_DATASET_DIR) for a
+    real-data run."""
+    if name in _warned:
+        return
+    _warned.add(name)
+    import sys
+
+    print(f"flexflow_tpu: WARNING: {name} not found in "
+          f"{_search_dirs()} — using a DETERMINISTIC SYNTHETIC stand-in "
+          f"(real shapes/dtypes, fake content)", file=sys.stderr, flush=True)
+
+
 def get_file(fname: str, origin: str = "", file_hash: str = "",
              cache_subdir: str = "datasets") -> Optional[str]:
     """Reference-compatible signature; resolves locally only.

@@ -74,6 +74,11 @@ class FFModel:
         self._constants: Dict[int, Any] = {}  # guid -> (Tensor, fill value)
         self._offload: Dict[Tuple[str, str], Any] = {}  # host-offloaded weights
         self._offload_warned = False
+        # Row-sparse host-resident embedding tables (reference:
+        # embedding.cc CPU tasks touch only the batch's rows): op name ->
+        # {"weight", "input", "input_key", "u_max"}
+        self._host_embed: Dict[str, Dict[str, Any]] = {}
+        self._host_idx: Dict[str, np.ndarray] = {}  # host copies of index batches
         self.label_tensor: Optional[Tensor] = None
         self.machine: Optional[Machine] = None
         self.optimizer = None
@@ -143,7 +148,7 @@ class FFModel:
                padding_w: int, activation: str = ActiMode.NONE,
                use_bias: bool = True, groups: int = 1,
                kernel_initializer=None, bias_initializer=None,
-               share_with=None, name: Optional[str] = None) -> Tensor:
+               *, share_with=None, name: Optional[str] = None) -> Tensor:
         return self._append(Conv2D(self, input_tensor, out_channels, kernel_h,
                                    kernel_w, stride_h, stride_w, padding_h,
                                    padding_w, activation, use_bias, groups,
@@ -161,7 +166,7 @@ class FFModel:
     def dense(self, input_tensor: Tensor, out_dim: int,
               activation: str = ActiMode.NONE, use_bias: bool = True,
               kernel_initializer=None, bias_initializer=None,
-              share_with=None, name: Optional[str] = None) -> Tensor:
+              *, share_with=None, name: Optional[str] = None) -> Tensor:
         return self._append(Linear(self, input_tensor, out_dim, activation,
                                    use_bias, kernel_initializer,
                                    bias_initializer, share_with, name))
@@ -368,15 +373,17 @@ class FFModel:
             stages = balanced_stages(seg, req["num_stages"])
         S = len(stages)
 
-        # Validate dataflow FIRST (structural errors surface regardless of
-        # whether a ring is expressible): one boundary tensor between
-        # consecutive stages; nothing else crosses a stage or escapes.
-        # Shared with the stage-assignment search so it never recommends
-        # a plan this planner would reject.
-        from .parallel.pipeline_plan import validate_stages
+        # Dataflow plan FIRST (structural errors surface regardless of
+        # whether a ring is expressible): each hop carries the k tensors
+        # later stages still need (branching graphs, skip connections and
+        # multi-input stage 0 welcome).  Shared with the stage-assignment
+        # search so it never recommends a plan this planner would reject.
+        from .parallel.pipeline_plan import plan_boundaries
 
-        validate_stages(stages, tail, set(self._constants.keys()))
-        seg_in = stages[0][0].inputs[0]
+        seg_ins, boundaries = plan_boundaries(
+            stages, tail, set(self._constants.keys()), self.input_tensors)
+        if not seg_ins:
+            raise ValueError("pipeline: segment consumes no graph input")
         final_out = stages[-1][-1].output
 
         import math
@@ -406,7 +413,9 @@ class FFModel:
             "stages": stages, "degree": int(degree),
             "dp_degree": int(req["dp_degree"]),
             "num_microbatches": int(req["num_microbatches"]),
-            "seg_in": seg_in, "seg_out": final_out,
+            "seg_ins": seg_ins, "boundaries": boundaries,
+            "seg_in_guids": {t.guid for t in seg_ins},
+            "seg_out": final_out,
             "i0": self.ops.index(stages[0][0]),
             "i1": self.ops.index(stages[-1][-1]) + 1,
         }
@@ -506,7 +515,56 @@ class FFModel:
             self.machine.mesh,
             PartitionSpec(paxes if len(paxes) > 1 else paxes[0]))
 
-    def _stage_fn(self, stage_ops: List[Op], in_guid: int):
+    # -- k-tensor ring-payload bundles (branching pipeline graphs) -----
+    @staticmethod
+    def _bundle_layout(tensors, pdtype):
+        """[(tensor, offset, per-sample flat n, lanes)] + total width.
+
+        The payload rides the compute dtype.  int32 tensors BITCAST in
+        exactly: one f32 lane each on a float32 payload, two 16-bit
+        lanes each on a bfloat16 payload — never a lossy value cast, and
+        no f32 fallback doubling every hop's bandwidth for one token-id
+        input (lax.bitcast has a zero JVP, so autodiff treats indices as
+        the non-differentiable data they are)."""
+        two_lane = jnp.dtype(pdtype).itemsize == 2
+        layout, off = [], 0
+        for t in tensors:
+            n = int(np.prod(t.dims[1:])) if len(t.dims) > 1 else 1
+            lanes = n * (2 if two_lane and "int" in t.dtype else 1)
+            layout.append((t, off, n, lanes))
+            off += lanes
+        return layout, max(off, 1)
+
+    @staticmethod
+    def _bundle_pack(env, layout, pdtype):
+        """Pack boundary tensors into one (B, width) payload."""
+        parts = []
+        for t, _, n, lanes in layout:
+            v = env[t.guid]
+            v = v.reshape(v.shape[0], n)
+            if "int" in t.dtype:
+                v = jax.lax.bitcast_convert_type(v.astype(jnp.int32),
+                                                 pdtype)
+                v = v.reshape(v.shape[0], lanes)  # (B,n,2)->(B,2n) on bf16
+            parts.append(v.astype(pdtype))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 1)
+
+    def _bundle_unpack(self, h, layout, pdtype):
+        cdtype = self.compute_dtype
+        env = {}
+        for t, off, n, lanes in layout:
+            v = h[:, off:off + lanes]
+            if "int" in t.dtype:
+                if lanes != n:  # two 16-bit lanes per int32
+                    v = v.reshape(v.shape[0], n, 2)
+                v = jax.lax.bitcast_convert_type(v.astype(pdtype), jnp.int32)
+            else:
+                v = v.astype(cdtype)
+            env[t.guid] = v.reshape((h.shape[0],) + tuple(t.dims[1:]))
+        return env
+
+    def _stage_fn(self, stage_ops: List[Op], in_layout, out_layout,
+                  pdtype):
         const_items = list(self._constants.values())
         pack = self._pipe_pack()
 
@@ -528,34 +586,42 @@ class FFModel:
                    if ctx.rng is not None else None)
             mctx = FwdCtx(training=ctx.training, rng=rng,
                           stats_in=ctx.stats_in, stats_out=ctx.stats_out)
-            env = {in_guid: h}
+            env = self._bundle_unpack(h, in_layout, pdtype)
             for t, val in const_items:
-                fill_dtype = jnp.int32 if "int" in t.dtype else h.dtype
+                fill_dtype = jnp.int32 if "int" in t.dtype \
+                    else self.compute_dtype
                 env[t.guid] = jnp.full(t.dims, val, fill_dtype)
             for op in stage_ops:
                 xs = [env[t.guid] for t in op.inputs]
                 ys = op.forward(resolve(params, op), xs, mctx)
                 for t, y in zip(op.outputs, ys):
                     env[t.guid] = y
-            return env[stage_ops[-1].output.guid]
+            return self._bundle_pack(env, out_layout, pdtype)
 
         return fn
 
-    def _run_pipeline_segment(self, params, x, ctx):
+    def _run_pipeline_segment(self, params, env, ctx):
         from .parallel.pipeline import pipeline_graph_apply
 
         plan = self._pipeline_plan
         stages = plan["stages"]
-        fns = []
-        in_t = plan["seg_in"]
-        prev = in_t
-        in_shapes, out_shapes = [], []
-        for g in stages:
-            f = self._stage_fn(g, prev.guid)
+        seg_ins, boundaries = plan["seg_ins"], plan["boundaries"]
+        seg_out = plan["seg_out"]
+        pdtype = self.compute_dtype  # ints bitcast in (see _bundle_layout)
+        in_bundles = [list(seg_ins)] + [list(h) for h in boundaries]
+        out_bundles = [list(h) for h in boundaries] + [[seg_out]]
+        fns, in_shapes, out_shapes = [], [], []
+        in0_layout = None
+        for si, g in enumerate(stages):
+            in_l, n_in = self._bundle_layout(in_bundles[si], pdtype)
+            out_l, n_out = self._bundle_layout(out_bundles[si], pdtype)
+            if si == 0:
+                in0_layout = in_l
+            f = self._stage_fn(g, in_l, out_l, pdtype)
             fns.append(lambda p, h, mi, f=f: f(p, h, ctx, mi))
-            in_shapes.append(tuple(prev.dims[1:]))
-            out_shapes.append(tuple(g[-1].output.dims[1:]))
-            prev = g[-1].output
+            in_shapes.append((n_in,))
+            out_shapes.append((n_out,))
+        x = self._bundle_pack(env, in0_layout, pdtype)
         groups = self.machine.axes_for_degrees(
             [plan["dp_degree"], plan["degree"]])
         batch_axes = groups[0] if groups[0] else None
@@ -576,10 +642,13 @@ class FFModel:
                            for k, v in seg_params.items()}
             param_specs["_pipe"] = {
                 "buffer": self._pipe_buffer_sharding().spec}
-        return pipeline_graph_apply(fns, seg_params, x, self.machine.mesh,
-                                    pipe_axes, mb, in_shapes, out_shapes,
-                                    batch_axes=batch_axes,
-                                    param_specs=param_specs)
+        y = pipeline_graph_apply(fns, seg_params, x, self.machine.mesh,
+                                 pipe_axes, mb, in_shapes, out_shapes,
+                                 batch_axes=batch_axes,
+                                 param_specs=param_specs)
+        out_l, _ = self._bundle_layout([seg_out], pdtype)
+        return self._bundle_unpack(y.reshape(x.shape[0], -1),
+                                   out_l, pdtype)[seg_out.guid]
 
     def _unary(self, op_name, x, name=None):
         return self._append(ElementUnary(self, x, op_name, name))
@@ -762,9 +831,45 @@ class FFModel:
     # parameter/state initialization (≈ FFModel::init_layers + initializer
     # tasks, src/runtime/initializer.cc)
     # ------------------------------------------------------------------
+    def _sparse_embed_ok(self, op) -> bool:
+        """Row-sparse host placement applies when the op is an Embedding
+        with its own table fed straight from a graph input, in a single
+        process, under a built-in SGD/Adam optimizer.  Auto mode
+        (``config.sparse_host_embeddings is None``) additionally requires
+        the update rule to be identity on untouched rows (plain SGD) so
+        sparse and dense training are bit-identical; forcing the flag
+        True opts into lazy per-touched-row semantics (torch
+        SparseAdam-style) for momentum/Adam."""
+        from .optimizers import AdamOptimizer, SGDOptimizer
+
+        if not (isinstance(op, Embedding) and op.share_from is None
+                and jax.process_count() == 1
+                and any(op.inputs[0] is t for t in self.input_tensors)
+                and isinstance(self.optimizer, (SGDOptimizer, AdamOptimizer))):
+            return False
+        # Swap-in REMAPS the index input's batch values to the compact
+        # row space, so every consumer of that input must be a
+        # host-placed own-table Embedding seeing the same remap — a
+        # mixed on-device consumer would silently look up compacted ids.
+        idx_t = op.inputs[0]
+        for o in self.ops:
+            if any(t is idx_t for t in o.inputs):
+                o_host = (o.pc.device_type == DeviceType.CPU
+                          or "host" in o.pc.memory_types)
+                if not (isinstance(o, Embedding) and o.share_from is None
+                        and o_host):
+                    return False
+        flag = getattr(self.config, "sparse_host_embeddings", None)
+        if flag is not None:
+            return bool(flag)
+        opt = self.optimizer
+        return (isinstance(opt, SGDOptimizer) and opt.momentum == 0.0
+                and opt.weight_decay == 0.0)
+
     def _param_spec_tree(self) -> Dict[str, Dict[str, NamedSharding]]:
         out: Dict[str, Dict[str, NamedSharding]] = {}
         self._offload: Dict[Tuple[str, str], Tuple[NamedSharding, NamedSharding]] = {}
+        self._host_embed = {}
         pack = self._pipe_pack()
         packed_keys = set(pack["entries"]) if pack else set()
         if pack:
@@ -792,6 +897,27 @@ class FFModel:
                 sh = NamedSharding(self.machine.mesh, PartitionSpec(*entries))
                 host_placed = (op.pc.device_type == DeviceType.CPU
                                or "host" in op.pc.memory_types)
+                if host_placed and self._sparse_embed_ok(op):
+                    # Row-sparse path (reference: embedding.cc:18-77 CPU
+                    # tasks + dlrm_strategy_hetero.cc host ZC tables):
+                    # the table lives host-side as numpy; each step
+                    # gathers ONLY the batch's unique rows to device and
+                    # scatters the updated rows back — per-step transfer
+                    # scales with the batch, not the table.  The spec
+                    # recorded here shards the per-step GATHERED rows
+                    # (replicated: they're batch-sized).
+                    idx_t = op.inputs[0]
+                    n_idx = int(np.prod(idx_t.dims))
+                    self._host_embed[op.name] = {
+                        "weight": w.name,
+                        "input": idx_t,
+                        "input_key": f"in_{idx_t.guid}",
+                        "u_max": int(min(op.num_entries,
+                                         -(-n_idx // 8) * 8)),
+                    }
+                    specs[w.name] = NamedSharding(self.machine.mesh,
+                                                  PartitionSpec())
+                    continue
                 if host_placed:
                     # Heterogeneous placement (reference: ParallelConfig::
                     # device_type=CPU routes ops to CPU task variants, and
@@ -820,6 +946,79 @@ class FFModel:
             out[op.name] = specs
         return out
 
+    def _host_embed_swap_in(self, params_in, opt_in, batch):
+        """Per-step row gather for host-resident embedding tables
+        (reference: embedding.cc:18-77 — CPU tasks touch only the
+        batch's rows).  For each registered table: unique the batch's
+        indices on host, gather those rows (padded to the static
+        ``u_max`` so the jit signature never changes), remap the index
+        batch to the compact row space, and gather the same rows of any
+        table-shaped optimizer slot.  The dense in-jit optimizer update
+        then IS the lazy per-touched-row update, and
+        ``_host_embed_scatter_back`` writes the rows home in place."""
+        rep = self.machine.replicated()
+        params_in = {k: (dict(v) if isinstance(v, dict) else v)
+                     for k, v in params_in.items()}
+        batch_in = dict(batch)
+        if opt_in is not None:
+            opt_in = {k: ({opn: dict(ws) for opn, ws in v.items()}
+                          if isinstance(v, dict) else v)
+                      for k, v in opt_in.items()}
+        ctxs = []
+        for opn, info in self._host_embed.items():
+            wn = info["weight"]
+            table = params_in[opn][wn]
+            key = info["input_key"]
+            idx = self._host_idx.get(key)
+            if idx is None:
+                idx = np.asarray(jax.device_get(batch[key]))
+            uniq, inv = np.unique(idx, return_inverse=True)
+            n = int(uniq.size)
+            u_max = info["u_max"]
+            uniq_p = np.zeros((u_max,), np.int64)
+            uniq_p[:n] = uniq
+            params_in[opn][wn] = jax.device_put(
+                np.ascontiguousarray(table[uniq_p]), rep)
+            batch_in[key] = self._place_batch(
+                inv.reshape(idx.shape).astype(np.int32),
+                self._input_batch_degree(info["input"]))
+            slots = []
+            if opt_in is not None:
+                for k, v in opt_in.items():
+                    full = (v.get(opn, {}).get(wn)
+                            if isinstance(v, dict) else None)
+                    if full is not None and \
+                            getattr(full, "shape", None) == table.shape:
+                        v[opn][wn] = jax.device_put(
+                            np.ascontiguousarray(np.asarray(full)[uniq_p]),
+                            rep)
+                        slots.append((k, full))
+            ctxs.append({"op": opn, "weight": wn, "table": table,
+                         "uniq": uniq, "n": n, "slots": slots})
+        return params_in, opt_in, batch_in, ctxs
+
+    def _host_embed_scatter_back(self, new_params, new_opt, ctxs):
+        """Write each table's updated rows (and optimizer-state rows)
+        back into the host arrays in place; the returned trees carry the
+        full host tables again."""
+        new_params = {k: (dict(v) if isinstance(v, dict) else v)
+                      for k, v in new_params.items()}
+        if new_opt is not None:
+            new_opt = {k: ({opn: dict(ws) for opn, ws in v.items()}
+                           if isinstance(v, dict) else v)
+                       for k, v in new_opt.items()}
+        for ctx in ctxs:
+            opn, wn, n = ctx["op"], ctx["weight"], ctx["n"]
+            uniq, table = ctx["uniq"], ctx["table"]
+            rows = np.asarray(new_params[opn][wn])
+            table[uniq] = rows[:n].astype(table.dtype)
+            new_params[opn][wn] = table
+            for k, full in ctx["slots"]:
+                srows = np.asarray(new_opt[k][opn][wn])
+                full[uniq] = srows[:n].astype(full.dtype)
+                new_opt[k][opn][wn] = full
+        return new_params, new_opt
+
     def _offload_put(self, tree, to_host: bool):
         """Move host-offloaded weights between pinned-host and device
         memory (params-shaped tree; missing entries are left alone)."""
@@ -847,7 +1046,8 @@ class FFModel:
         key = jax.random.key(seed)
         shardings = self._param_spec_tree()
 
-        ops_with_weights = [op for op in self.ops if op.weights]
+        ops_with_weights = [op for op in self.ops if op.weights
+                            and op.name not in self._host_embed]
         pack = self._pipe_pack()
 
         import zlib
@@ -881,9 +1081,23 @@ class FFModel:
         init_shardings = {opn: {wn: (self._offload[(opn, wn)][1]
                                      if (opn, wn) in self._offload else sh)
                                 for wn, sh in ws.items()}
-                          for opn, ws in shardings.items()}
+                          for opn, ws in shardings.items()
+                          if opn not in self._host_embed}
         self._params = jax.jit(init_fn, out_shardings=init_shardings)(key)
         self._params = self._offload_put(self._params, True)
+        # Row-sparse host tables: initialized on the host CPU backend
+        # (same threefry streams → bit-identical to a device init) and
+        # kept as numpy so per-step row scatter-updates are in-place.
+        for opn, info in self._host_embed.items():
+            op = next(o for o in self.ops if o.name == opn)
+            w = op.weights[0]
+            salt = zlib.crc32(f"{op.name}/{w.name}".encode())
+            cpu0 = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu0):
+                hkey = jax.device_put(key, cpu0)
+                v = np.array(w.initializer(jax.random.fold_in(hkey, salt),
+                                           w.dims, jnp.float32))
+            self._params.setdefault(opn, {})[w.name] = v
         self._stats = {}
         for op in self.ops:
             st = op.init_stats()
@@ -900,6 +1114,8 @@ class FFModel:
             # device_put pairs don't model Pallas aliasing); every other
             # leaf keeps the fused path.
             nonfused = set(self._offload)
+            nonfused |= {(opn, info["weight"])
+                         for opn, info in self._host_embed.items()}
             zero_specs = (self._zero_state_specs()
                           if self.config.zero_optimizer and multi else None)
             if zero_specs:
@@ -926,7 +1142,8 @@ class FFModel:
             if not op.weights or op.name not in self._params:
                 continue
             for w in op.weights:
-                if (op.name, w.name) in self._offload:
+                if (op.name, w.name) in self._offload \
+                        or op.name in self._host_embed:
                     continue
                 arr = self._params[op.name].get(w.name)
                 if arr is None:
@@ -956,8 +1173,31 @@ class FFModel:
     def _init_opt_state(self):
         # zeros_like does not carry memory kinds: pin offloaded entries'
         # state to host explicitly so every step sees consistent kinds.
-        state = self._offload_put_state(self.optimizer.init_state(self._params),
-                                        True)
+        params = self._params
+        if self._host_embed:
+            # Host-resident tables stay OUT of init_state (zeros_like
+            # would allocate a table-sized device buffer); their state
+            # lives host-side as numpy, scatter-updated per step.
+            params = {opn: ws for opn, ws in params.items()}
+            tables = {}
+            for opn, info in self._host_embed.items():
+                wn = info["weight"]
+                d = dict(params[opn])
+                tables[(opn, wn)] = d.pop(wn)
+                if d:
+                    params[opn] = d
+                else:
+                    params.pop(opn)
+            state = self.optimizer.init_state(params)
+            for v in state.values():
+                if isinstance(v, dict):
+                    for (opn, wn), tbl in tables.items():
+                        v.setdefault(opn, {})[wn] = np.zeros(tbl.shape,
+                                                             np.float32)
+            state = self._offload_put_state(state, True)
+        else:
+            state = self._offload_put_state(
+                self.optimizer.init_state(self._params), True)
         zero_specs = getattr(self.optimizer, "zero_specs", None)
         if zero_specs:
             mesh = self.machine.mesh
@@ -1003,8 +1243,7 @@ class FFModel:
                 # Pipelined segment: GPipe microbatch schedule over the
                 # pipe mesh axes (parallel/pipeline.py), replacing the
                 # sequential op walk for ops[i0:i1].
-                y = self._run_pipeline_segment(
-                    params, env[plan["seg_in"].guid], ctx)
+                y = self._run_pipeline_segment(params, env, ctx)
                 env[plan["seg_out"].guid] = y
                 i = plan["i1"]
                 continue
@@ -1035,7 +1274,7 @@ class FFModel:
 
     def _input_batch_degree(self, t: Tensor) -> int:
         plan = getattr(self, "_pipeline_plan", None)
-        if plan is not None and t.guid == plan["seg_in"].guid:
+        if plan is not None and t.guid in plan["seg_in_guids"]:
             return plan["dp_degree"]
         for op in self.ops:
             if t in op.inputs:
@@ -1149,7 +1388,18 @@ class FFModel:
     # ------------------------------------------------------------------
     def set_batch(self, inputs: Dict[Tensor, Any], labels: Any) -> None:
         batch: Dict[str, Any] = {}
+        he_keys = {info["input_key"] for info in self._host_embed.values()}
         for t, arr in inputs.items():
+            key = f"in_{t.guid}"
+            if key in he_keys:
+                if not isinstance(arr, jax.Array):
+                    # keep a host copy: the sparse gather uniques these
+                    # indices on host per step without a device round-trip
+                    self._host_idx[key] = np.asarray(arr)
+                else:
+                    # device-array batch: drop any stale host copy so
+                    # swap-in falls back to device_get of THIS batch
+                    self._host_idx.pop(key, None)
             batch[f"in_{t.guid}"] = self._place_batch(arr, self._input_batch_degree(t))
         deg = getattr(self.ops[-1], "pc", ParallelConfig(dims=(1,))).dims[0] \
             if self.ops else 1
@@ -1197,10 +1447,17 @@ class FFModel:
         # iterations; the step itself computes on the accelerator).
         params_in = self._offload_put(self._params, False)
         opt_in = self._offload_put_state(self._opt_state, False)
+        batch_in, he_ctxs = self._batch, None
+        if self._host_embed:
+            params_in, opt_in, batch_in, he_ctxs = \
+                self._host_embed_swap_in(params_in, opt_in, self._batch)
         new_params, self._stats, new_opt, self._metric_acc = \
             self._train_step_fn(params_in, self._stats, opt_in,
-                                hp, self._batch, jnp.uint32(self._step_count),
+                                hp, batch_in, jnp.uint32(self._step_count),
                                 self._metric_acc)
+        if he_ctxs:
+            new_params, new_opt = self._host_embed_scatter_back(
+                new_params, new_opt, he_ctxs)
         self._params = self._offload_put(new_params, True)
         self._opt_state = self._offload_put_state(new_opt, True)
         self._step_count += 1
@@ -1213,19 +1470,27 @@ class FFModel:
         self.backward()
         self.update()
 
+    def _eval_inputs(self):
+        params_in = self._offload_put(self._params, False)
+        batch_in = self._batch
+        if self._host_embed:
+            params_in, _, batch_in, _ = self._host_embed_swap_in(
+                params_in, None, self._batch)
+        return params_in, batch_in
+
     def eval_batch(self) -> Dict[str, float]:
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
-        msum, _ = self._eval_step_fn(self._offload_put(self._params, False),
-                                     self._stats, self._batch)
+        params_in, batch_in = self._eval_inputs()
+        msum, _ = self._eval_step_fn(params_in, self._stats, batch_in)
         return {k: float(v) for k, v in msum.items()}
 
     def predict_batch(self) -> np.ndarray:
         """Final-op outputs (probabilities) for the staged batch."""
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
-        _, probs = self._eval_step_fn(self._offload_put(self._params, False),
-                                      self._stats, self._batch)
+        params_in, batch_in = self._eval_inputs()
+        _, probs = self._eval_step_fn(params_in, self._stats, batch_in)
         return np.asarray(probs)
 
     # ------------------------------------------------------------------
@@ -1285,12 +1550,16 @@ class FFModel:
         overlong request instead of degrading silently."""
         if pos_t is None:
             return
+        # the scan runs P+N-1 steps over positions 0..P+N-2, so the
+        # largest index used is s_max-2 — a table of s_max-1 entries is
+        # exactly enough
         for op in self.ops:
             if isinstance(op, Embedding) and op.inputs[0] is pos_t \
-                    and s_max > op.num_entries:
+                    and s_max - 1 > op.num_entries:
                 raise ValueError(
-                    f"decode: prompt + max_new_tokens = {s_max} exceeds "
-                    f"the position table ({op.num_entries} entries)")
+                    f"decode: prompt + max_new_tokens = {s_max} needs "
+                    f"{s_max - 1} positions but the position table has "
+                    f"only {op.num_entries} entries")
 
     def _static_decode_ops(self, extra_guids):
         """Ops reachable from the FIXED extra inputs alone (a seq2seq
@@ -1698,6 +1967,10 @@ class FFModel:
             self._params["_pipe"]["buffer"] = jax.device_put(new, cur.sharding)
             return
         cur = self._params[op_name][weight_name]
+        if isinstance(cur, np.ndarray):  # row-sparse host-resident table
+            self._params[op_name][weight_name] = np.asarray(
+                value, dtype=cur.dtype).reshape(cur.shape).copy()
+            return
         self._params[op_name][weight_name] = jax.device_put(
             jnp.asarray(value, dtype=cur.dtype), cur.sharding)
 

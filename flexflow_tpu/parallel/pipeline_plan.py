@@ -35,36 +35,72 @@ def balanced_stages(ops: Sequence, num_stages: int) -> List[List]:
     return [g for g in stages if g]
 
 
-def validate_stages(stages: List[List], tail: Sequence,
-                    const_guids) -> None:
-    """Dataflow rules of the GPipe ring (one boundary tensor between
-    consecutive stages; nothing else crosses a stage or escapes).
-    Raises ``ValueError`` on violation."""
+def plan_boundaries(stages: List[List], tail: Sequence, const_guids,
+                    input_tensors: Sequence):
+    """Dataflow plan for the GPipe ring over an ARBITRARY graph.
+
+    Each stage is any subgraph (branches, multiple inputs, skip
+    connections welcome — the reference pipelines arbitrary per-op GPU
+    placements, nmt/nmt.cc:269-308).  The hop from stage ``si`` to
+    ``si+1`` carries ``boundaries[si]``: every tensor already available
+    after stage ``si`` (graph input or produced at a stage <= si) that a
+    later stage still needs — k tensors per hop, packed into one flat
+    ring payload by the executor.  A tensor produced at stage 1 and
+    consumed at stage 3 simply rides two hops.
+
+    Returns ``(seg_ins, boundaries)`` where ``seg_ins`` is the ordered
+    list of graph inputs the segment consumes (stage 0's inbound
+    bundle).  Raises ``ValueError`` when a non-final tensor escapes to
+    the tail, or a stage consumes a tensor no earlier stage produced
+    (a non-topological partition).
+    """
     S = len(stages)
+    input_guids = {t.guid for t in input_tensors}
     stage_of: Dict[int, int] = {}
     for si, g in enumerate(stages):
         for op in g:
             for t in op.outputs:
                 stage_of[t.guid] = si
-    seg_in = stages[0][0].inputs[0]
-    boundaries = []
+
+    # consumption map: guid -> last stage that reads it
+    last_use: Dict[int, int] = {}
+    seen_inputs: Dict[int, object] = {}
     for si, g in enumerate(stages):
-        expected = seg_in if si == 0 else boundaries[si - 1]
         for op in g:
             for t in op.inputs:
-                if t.guid in const_guids or t.guid == expected.guid:
+                if t.guid in const_guids:
                     continue
-                if stage_of.get(t.guid) == si:
-                    continue
-                raise ValueError(
-                    f"pipeline: op {op.name} (stage {si}) consumes "
-                    f"tensor from stage {stage_of.get(t.guid)} that is "
-                    f"not the stage boundary; re-partition the stages")
-        if si < S - 1:
-            boundaries.append(g[-1].output)
+                if t.guid in input_guids:
+                    seen_inputs.setdefault(t.guid, t)
+                elif t.guid not in stage_of:
+                    raise ValueError(
+                        f"pipeline: op {op.name} (stage {si}) consumes "
+                        f"tensor {t.guid} produced by no stage and not a "
+                        f"graph input — stages must follow a topological "
+                        f"order of the graph")
+                elif stage_of[t.guid] > si:
+                    raise ValueError(
+                        f"pipeline: op {op.name} (stage {si}) consumes a "
+                        f"tensor from LATER stage {stage_of[t.guid]} — "
+                        f"stages must follow a topological order")
+                last_use[t.guid] = max(last_use.get(t.guid, -1), si)
+
+    seg_ins = sorted(seen_inputs.values(), key=lambda t: t.guid)
+    boundaries: List[List] = []
+    all_tensors = {t.guid: t for g in stages for op in g for t in op.outputs}
+    all_tensors.update(seen_inputs)
+    for si in range(S - 1):
+        hop = [t for guid, t in sorted(all_tensors.items())
+               if last_use.get(guid, -1) > si
+               and (guid in seen_inputs or stage_of.get(guid, S) <= si)]
+        boundaries.append(hop)
+
     final_out = stages[-1][-1].output
     inner = set(stage_of.keys()) - {final_out.guid}
     for op in tail:
         for t in op.inputs:
             if t.guid in inner:
                 raise ValueError("pipeline: tensor escapes the segment")
+    return seg_ins, boundaries
+
+

@@ -189,9 +189,49 @@ def _save_npz(model, path: str) -> None:
     np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
 
 
+def _convert_legacy_pipe(model, data) -> Dict[str, np.ndarray]:
+    """v0.3.x pipelined checkpoints stored the packed per-stage weight
+    buffer verbatim (``.../_pipe/buffer``); the layout-portable format
+    stores per-op arrays.  Expand legacy entries on load using the
+    model's current pack layout — or fail with a message that names the
+    problem instead of an opaque KeyError from the rebuild."""
+    out = {k: data[k] for k in data.files}
+    legacy = [k for k in out if k.endswith("_pipe/buffer")]
+    if not legacy:
+        return out
+    pack = model._pipe_pack() if hasattr(model, "_pipe_pack") else None
+    if not pack:
+        raise ValueError(
+            "checkpoint predates the layout-portable format (packed "
+            "_pipe buffer) and the current model is not pipelined with "
+            "a matching stage split — re-save it from a v0.3.x run or "
+            "compile with the original pipeline plan to convert it")
+    for k in legacy:
+        prefix = k[:-len("_pipe/buffer")]
+        buf = out.pop(k)
+        try:
+            for opn, ws in pack["entries"].items():
+                for wn, e in ws.items():
+                    out[f"{prefix}{opn}/{wn}"] = _pack_read_host(buf, e)
+        except Exception as exc:
+            raise ValueError(
+                f"legacy packed checkpoint entry {k!r} does not match "
+                f"the current pipeline pack layout ({exc}) — compile "
+                "with the original stage split to convert it") from exc
+    # drop any remaining legacy _pipe metadata keys
+    return {k: v for k, v in out.items() if "/_pipe/" not in k}
+
+
+def _pack_read_host(buf, entry):
+    row = buf[entry[0]]
+    _, off, shape, n = entry
+    return np.asarray(row[off:off + n]).reshape(shape)
+
+
 def _load_npz(model, path: str) -> None:
     data = np.load(path if path.endswith(".npz") else path + ".npz",
                    allow_pickle=False)
+    data = _convert_legacy_pipe(model, data)
 
     def rebuild(template, prefix=""):
         if isinstance(template, dict):
@@ -207,12 +247,20 @@ def _load_npz(model, path: str) -> None:
     # Re-place arrays with the model's shardings.
     spec_tree = model._param_spec_tree()
 
+    he = getattr(model, "_host_embed", {})
+
     def place_params_like(tree, zero_specs=None):
         placed = {}
         for opn, ws in tree.items():
             shards = spec_tree.get(opn, {})
             placed[opn] = {}
             for wn, a in ws.items():
+                if opn in he and he[opn]["weight"] == wn:
+                    # row-sparse host table: stays host-side numpy
+                    # (np.array: a writable copy — scatter-updates are
+                    # in-place)
+                    placed[opn][wn] = np.array(a)
+                    continue
                 sh = shards.get(wn)
                 if zero_specs and (opn, wn) in zero_specs:
                     from jax.sharding import NamedSharding

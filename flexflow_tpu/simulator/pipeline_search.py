@@ -58,7 +58,7 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     the plan is not executable (branching dataflow the ring cannot
     carry, or shapes/batch that don't divide) — validated with the SAME
     rules FFModel._plan_pipeline enforces."""
-    from ..parallel.pipeline_plan import balanced_stages, validate_stages
+    from ..parallel.pipeline_plan import balanced_stages, plan_boundaries
 
     pair = _pipeline_segment(model)
     if pair is None or S < 2:
@@ -78,9 +78,10 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     if len(stages) != S:
         return None
     try:
-        validate_stages(stages, tail, set(model._constants.keys()))
+        seg_ins, boundaries = plan_boundaries(
+            stages, tail, set(model._constants.keys()), model.input_tensors)
     except ValueError:
-        return None  # branching graph: the ring can't carry this partition
+        return None  # non-topological partition
 
     # per-slot per-microbatch compute: cost the op at batch degree
     # batch/mb (so the sub-shape's leading dim is the microbatch size)
@@ -96,9 +97,20 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
         slot_t.append(t)
     t_slot = max(slot_t)
 
-    # boundary ring: buffers pad to the largest flattened boundary
-    bounds = [int(np.prod(stages[0][0].inputs[0].dims[1:]))]
-    bounds += [int(np.prod(g[-1].output.dims[1:])) for g in stages]
+    # boundary ring: buffers pad to the largest flattened bundle —
+    # stage-0's input bundle, each hop's k packed tensors, the final
+    # output (exactly what the runtime ships, model._run_pipeline_segment:
+    # on a 16-bit payload an int32 tensor bitcasts into TWO lanes)
+    two_lane = cost._dtype_bytes == 2.0
+
+    def width(ts):
+        return sum((int(np.prod(t.dims[1:])) if len(t.dims) > 1 else 1)
+                   * (2 if two_lane and "int" in t.dtype else 1)
+                   for t in ts)
+
+    bounds = [width(seg_ins)]
+    bounds += [width(hop) for hop in boundaries]
+    bounds.append(width([stages[-1][-1].output]))
     pad = max(bounds)
     t_comm = machine.transfer_time(0, 1, cost._dtype_bytes * mb * pad)
 

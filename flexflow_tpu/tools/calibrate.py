@@ -26,6 +26,8 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
+import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -65,50 +67,151 @@ def candidate_jobs(model, nd: int, cost, full: bool) -> List[Tuple]:
 
 
 def run_measurements(jobs, cost, max_seconds: float, verbose: bool,
-                     job_timeout: float = 300.0) -> int:
-    """Measure every job, with a per-job watchdog: a wedged TPU tunnel
-    hangs ALL device work indefinitely, so after two consecutive hung
-    jobs measuring aborts (keeping everything persisted so far) instead
-    of stalling the whole calibration run."""
-    import signal
+                     heartbeat_path: Optional[str] = None,
+                     skip_keys: Optional[set] = None) -> int:
+    """Measure every job (worker side — no in-process watchdog).
 
-    done, hung = 0, 0
+    A wedged TPU tunnel hangs device work inside a blocking C++ wait
+    where Python signal handlers can never fire, so the watchdog lives
+    in the SUPERVISING process (``supervise_worker``): before each job
+    this loop writes a heartbeat record; the supervisor kills this
+    whole process when a heartbeat goes stale and restarts it with the
+    stuck key excluded.  Every finished measurement is already persisted
+    by ``CostModel._persist``, so a kill loses at most the in-flight job."""
+    done = 0
     t_start = time.time()
+    skip_keys = skip_keys or set()
 
-    def _alarm(signum, frame):
-        raise TimeoutError("measurement hung (tunnel wedged?)")
-
-    old = signal.signal(signal.SIGALRM, _alarm)
-    try:
-        for i, (op, pc, which, key) in enumerate(jobs):
-            if time.time() - t_start > max_seconds:
-                print(f"[calibrate] time budget hit after "
-                      f"{done}/{len(jobs)} jobs")
-                break
-            signal.alarm(int(job_timeout))
+    def beat(key, i):
+        if heartbeat_path:
             try:
-                t = cost.op_time(op, pc, which)
-                hung = 0
-            except TimeoutError:
-                hung += 1
-                print(f"[calibrate] job {i + 1} hung >{job_timeout:.0f}s "
-                      f"({key}) — {'aborting' if hung >= 2 else 'skipping'}",
-                      flush=True)
-                if hung >= 2:
-                    break
-                continue
-            finally:
-                signal.alarm(0)
-            done += 1
-            if verbose:
-                src = ("measured" if key in cost._measured
-                       else "ANALYTIC(fallback)")
-                print(f"[{i + 1}/{len(jobs)}] {key} -> {t * 1e6:.1f} us "
-                      f"[{src}]", flush=True)
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
+                # atomic replace: the supervisor polls concurrently and a
+                # torn read must never masquerade as a wedged worker
+                tmp = heartbeat_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"key": key, "i": i, "t": time.time()}, f)
+                os.replace(tmp, heartbeat_path)
+            except OSError:
+                pass
+
+    for i, (op, pc, which, key) in enumerate(jobs):
+        if time.time() - t_start > max_seconds:
+            print(f"[calibrate] time budget hit after "
+                  f"{done}/{len(jobs)} jobs", flush=True)
+            break
+        if key in skip_keys:
+            print(f"[{i + 1}/{len(jobs)}] {key} SKIPPED "
+                  f"(hung a previous attempt)", flush=True)
+            continue
+        beat(key, i)
+        t = cost.op_time(op, pc, which)
+        done += 1
+        if verbose:
+            src = ("measured" if key in cost._measured
+                   else "ANALYTIC(fallback)")
+            print(f"[{i + 1}/{len(jobs)}] {key} -> {t * 1e6:.1f} us "
+                  f"[{src}]", flush=True)
+    beat(None, len(jobs))
     return done
+
+
+def supervise_worker(argv: List[str], job_timeout: float,
+                     max_restarts: int = 2,
+                     max_seconds: float = 3600.0) -> int:
+    """Parent-side watchdog (the fix for the SIGALRM flaw: a Python
+    alarm can't interrupt a blocked jax.device_get, but SIGKILL-ing a
+    subprocess always works — same pattern as doctor.py's accelerator
+    probe).  Spawns ``calibrate --worker``; when the per-job heartbeat
+    goes stale past ``job_timeout`` — or the worker never produces its
+    FIRST beat within the startup deadline (a tunnel wedged inside
+    backend init hangs before any job starts) — the worker is killed,
+    the in-flight key is excluded, and the worker restarts (resuming
+    from the durable cache).  A global wall budget bounds the whole
+    supervision.  Returns the last worker returncode."""
+    import subprocess
+    import tempfile
+
+    hb = tempfile.NamedTemporaryFile(prefix="ffcal_hb_", suffix=".json",
+                                     delete=False)
+    hb.close()
+    skipfile = tempfile.NamedTemporaryFile(prefix="ffcal_skip_",
+                                           suffix=".txt", delete=False)
+    skipfile.close()
+    cmd = [sys.executable, "-m", "flexflow_tpu.tools.calibrate",
+           "--worker", "--heartbeat", hb.name,
+           "--skip-keys-file", skipfile.name] + argv
+    # backend init + imports + job-list build can take minutes over a
+    # healthy tunnel; only a deadline well past that means "wedged"
+    startup_timeout = max(job_timeout, 420.0)
+    t_global = time.time()
+    try:
+        for attempt in range(max_restarts + 1):
+            # reset the heartbeat so the previous attempt's stale record
+            # can't get the fresh worker killed at its first poll
+            with open(hb.name, "w"):
+                pass
+            t_spawn = time.time()
+            proc = subprocess.Popen(cmd)
+            stuck_key = None
+            measuring_done = False  # saw the worker's {"key": null} sentinel
+            while True:
+                try:
+                    rc = proc.wait(timeout=5.0)
+                    if rc != 0:
+                        print(f"[calibrate] worker exited rc={rc}",
+                              flush=True)
+                    return rc
+                except subprocess.TimeoutExpired:
+                    pass
+                if time.time() - t_global > max_seconds:
+                    print("[calibrate] global wall budget exhausted — "
+                          "killing worker, keeping measurements so far",
+                          flush=True)
+                    proc.kill()
+                    proc.wait()
+                    return 1
+                try:
+                    with open(hb.name) as f:
+                        beat = json.load(f)
+                except (OSError, ValueError):
+                    beat = None
+                if beat and beat.get("key"):
+                    if time.time() - beat["t"] > job_timeout:
+                        stuck_key = beat["key"]
+                        print(f"[calibrate] job hung >{job_timeout:.0f}s "
+                              f"({stuck_key}) — killing worker (attempt "
+                              f"{attempt + 1}/{max_restarts + 1})",
+                              flush=True)
+                        proc.kill()
+                        proc.wait()
+                        break
+                elif beat is not None and beat.get("key", "") is None:
+                    # measurement loop finished; teardown (tunnel/backend
+                    # shutdown) may take a while — never kill for it
+                    measuring_done = True
+                elif not measuring_done \
+                        and time.time() - t_spawn > startup_timeout:
+                    # no first beat: wedged before the job loop started
+                    print(f"[calibrate] worker produced no heartbeat in "
+                          f"{startup_timeout:.0f}s (backend init wedged?) "
+                          f"— killing (attempt "
+                          f"{attempt + 1}/{max_restarts + 1})", flush=True)
+                    proc.kill()
+                    proc.wait()
+                    break
+            if stuck_key:
+                with open(skipfile.name, "a") as f:
+                    f.write(stuck_key + "\n")
+            if attempt == max_restarts:
+                print("[calibrate] restart budget exhausted — keeping the "
+                      "measurements persisted so far", flush=True)
+        return 1
+    finally:
+        for p in (hb.name, skipfile.name):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 def collect_fit_records(models, nds, cost) -> List[Dict]:
@@ -209,11 +312,51 @@ def main(argv: Optional[List[str]] = None):
                         "TPU-tagged entries already in the cache (runs "
                         "on any backend — e.g. after a tunnel drop cut "
                         "a calibration run short)")
+    p.add_argument("--job-timeout", type=float, default=240.0,
+                   help="supervisor kills the measuring worker if one "
+                        "job's heartbeat goes stale this long")
+    p.add_argument("--max-restarts", type=int, default=2)
+    p.add_argument("--no-supervise", action="store_true",
+                   help="measure in-process (no watchdog — a wedged "
+                        "tunnel will hang this process forever)")
+    p.add_argument("--platform", default=None,
+                   help="force the jax platform (e.g. 'cpu' for a dry "
+                        "run — the axon sitecustomize ignores "
+                        "JAX_PLATFORMS, so this sets jax.config instead)")
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--heartbeat", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--skip-keys-file", default=None, help=argparse.SUPPRESS)
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
+    if not (args.fit_only or args.worker or args.no_supervise):
+        # Supervisor mode: ALL device work happens in a killable worker
+        # subprocess (a SIGALRM in this process could never interrupt a
+        # wedged C++ device wait); afterwards fit from the durable cache.
+        fwd = []
+        for flag, val in (("--devices", args.devices),
+                          ("--alexnet-batch", args.alexnet_batch),
+                          ("--bench-batch", args.bench_batch),
+                          ("--inception-jobs", args.inception_jobs),
+                          ("--compute-dtype", args.compute_dtype),
+                          ("--max-seconds", args.max_seconds)):
+            fwd += [flag, str(val)]
+        if not args.inception:
+            fwd.append("--no-inception")
+        if args.out:
+            fwd += ["--out", args.out]
+        if args.platform:
+            fwd += ["--platform", args.platform]
+        if args.quiet:
+            fwd.append("--quiet")
+        supervise_worker(fwd, args.job_timeout, args.max_restarts,
+                         max_seconds=args.max_seconds + 900.0)
+        args.fit_only = True  # fall through to the CPU-side fit below
+
     import jax
 
+    if args.platform and not args.fit_only:
+        jax.config.update("jax_platforms", args.platform)
     if args.fit_only:
         # no measuring — don't init (or hang on) the TPU backend
         jax.config.update("jax_platforms", "cpu")
@@ -262,13 +405,23 @@ def main(argv: Optional[List[str]] = None):
         jobs += ijobs
 
     print(f"[calibrate] {len(jobs)} measurement jobs "
-          f"(cache: {len(cost._measured)} entries pre-loaded)")
+          f"(cache: {len(cost._measured)} entries pre-loaded)", flush=True)
     if args.fit_only:
         print("[calibrate] --fit-only: skipping measurement, refitting "
               "from the cached TPU entries")
     else:
+        skip = set()
+        if args.skip_keys_file and os.path.exists(args.skip_keys_file):
+            with open(args.skip_keys_file) as f:
+                skip = {ln.strip() for ln in f if ln.strip()}
         run_measurements(jobs, cost, args.max_seconds,
-                         verbose=not args.quiet)
+                         verbose=not args.quiet,
+                         heartbeat_path=args.heartbeat, skip_keys=skip)
+        if args.worker:
+            # fit happens in the supervising parent, from the cache
+            print(f"[calibrate] worker done: {len(cost._measured)} "
+                  f"entries -> {out}", flush=True)
+            return
 
     recs = collect_fit_records(models, nds, cost)
     fit = fit_machine(recs, mm)

@@ -74,7 +74,11 @@ def test_memory_types_host_triggers_offload(devices):
               ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
               [ff.MetricsType.ACCURACY])
     m.init_layers()
-    assert m._params["emb"]["weight"].sharding.memory_kind == "pinned_host"
+    # plain SGD qualifies for the row-sparse path: the table is
+    # host-resident numpy (tests/test_sparse_host_embed.py covers it);
+    # the point here is that memory_types=("host",) drove host placement
+    assert "emb" in m._host_embed
+    assert isinstance(m._params["emb"]["weight"], np.ndarray)
 
 
 def test_offloaded_momentum_state_in_host_memory(devices):

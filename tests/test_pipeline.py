@@ -207,8 +207,10 @@ def test_general_pipeline_transformer(devices):
     np.testing.assert_allclose(hk_ref, hk_pp, rtol=3e-4, atol=3e-5)
 
 
-def test_general_pipeline_validation(devices):
-    """A tensor crossing a non-boundary stage edge must be rejected."""
+def test_general_pipeline_skip_connection_rides_hops(devices):
+    """A tensor consumed two stages later rides the intermediate hop as
+    part of the k-tensor ring payload (generalized planner — the old
+    single-boundary rule rejected this)."""
     cfg = ff.FFConfig(batch_size=8)
     m = ff.FFModel(cfg)
     inp = m.create_tensor((8, 16), nchw=False)
@@ -216,7 +218,25 @@ def test_general_pipeline_validation(devices):
     t2 = m.dense(t1, 16, name="fc2")
     m.add(t1, t2, name="skip")  # reads fc1 output from two stages back
     m.set_pipeline(stages=[["fc1"], ["fc2"], ["skip"]])
-    with pytest.raises(ValueError, match="not the stage boundary"):
+    m.compile(ff.SGDOptimizer(lr=0.05),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    plan = m._pipeline_plan
+    if plan is not None:  # ring expressible on this mesh
+        # hop 1 (fc2 -> skip) carries BOTH fc1's and fc2's outputs
+        assert len(plan["boundaries"][1]) == 2
+
+
+def test_general_pipeline_validation(devices):
+    """A non-topological stage order (a stage consuming a LATER stage's
+    tensor) must be rejected."""
+    cfg = ff.FFConfig(batch_size=8)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((8, 16), nchw=False)
+    t1 = m.dense(inp, 16, name="fc1")
+    t2 = m.dense(t1, 16, name="fc2")
+    m.add(t1, t2, name="skip")
+    m.set_pipeline(stages=[["fc1"], ["skip"], ["fc2"]])
+    with pytest.raises(ValueError, match="contiguous|topological"):
         m.compile(ff.SGDOptimizer(lr=0.05),
                   "sparse_categorical_crossentropy", ["accuracy"])
 

@@ -1,0 +1,149 @@
+"""Row-sparse host-resident embedding tables.
+
+Reference: src/ops/embedding.cc:18-77 — the CPU embedding tasks touch
+only the batch's rows of a host-zero-copy table; dlrm_strategy_hetero.cc
+places 8x1M-row DLRM tables in host ZC memory.  Under test here: a
+host-placed Embedding under plain SGD keeps its table host-side as
+numpy, per-step transfer scales with the BATCH (u_max rows), not the
+table, and training matches the dense device run bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.config import DeviceType
+
+
+def _build(offload: bool, rows: int = 1000, momentum: float = 0.0,
+           sparse=None, batch: int = 16):
+    cfg = ff.FFConfig(batch_size=batch)
+    cfg.sparse_host_embeddings = sparse
+    if offload:
+        cfg.strategies["emb"] = ff.ParallelConfig(
+            DeviceType.CPU, (1, 1), (0,))
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((batch, 4), dtype="int32", name="ids")
+    t = m.embedding(ids, rows, 8, name="emb")
+    t = m.dense(t, 4, name="head")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(m, lr=0.1, momentum=momentum),
+              ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [ff.MetricsType.ACCURACY])
+    m.init_layers(seed=11)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, rows, (batch, 4)).astype(np.int32)
+    y = (x[:, 0] % 4).astype(np.int32).reshape(-1, 1)
+    m.set_batch({ids: x}, y)
+    return m
+
+
+def test_sparse_table_is_host_numpy(devices):
+    m = _build(offload=True)
+    assert "emb" in m._host_embed
+    assert isinstance(m._params["emb"]["weight"], np.ndarray)
+    # registered instead of the full-streaming path
+    assert ("emb", "weight") not in m._offload
+
+
+def test_sparse_training_matches_dense(devices):
+    m_dev = _build(offload=False)
+    m_host = _build(offload=True)
+    assert "emb" in m_host._host_embed
+    # identical init (threefry streams are platform-independent)
+    np.testing.assert_array_equal(m_dev.get_parameter("emb", "weight"),
+                                  m_host.get_parameter("emb", "weight"))
+    for _ in range(8):
+        m_dev.train_iteration()
+        m_host.train_iteration()
+    m_dev.sync()
+    m_host.sync()
+    np.testing.assert_allclose(m_dev.get_parameter("emb", "weight"),
+                               m_host.get_parameter("emb", "weight"),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(m_dev.get_parameter("head", "kernel"),
+                               m_host.get_parameter("head", "kernel"),
+                               rtol=2e-5, atol=2e-6)
+    # the table is STILL host-resident numpy after training
+    assert isinstance(m_host._params["emb"]["weight"], np.ndarray)
+
+
+def test_transfer_scales_with_batch_not_table(devices):
+    """The device-side leaf fed into the step is (u_max, D) where u_max
+    derives from the BATCH's index count — growing the table leaves the
+    per-step transfer unchanged."""
+    m_small = _build(offload=True, rows=500)
+    m_large = _build(offload=True, rows=50_000)
+    u_small = m_small._host_embed["emb"]["u_max"]
+    u_large = m_large._host_embed["emb"]["u_max"]
+    assert u_small == u_large  # batch-driven, not table-driven
+    assert u_large * 8 < 50_000  # far below table row count
+    p_in, _, batch_in, ctxs = m_large._host_embed_swap_in(
+        m_large._params, m_large._opt_state, m_large._batch)
+    assert p_in["emb"]["weight"].shape == (u_large, 8)
+    m_large.train_iteration()
+    m_large.sync()
+
+
+def test_untouched_rows_do_not_move(devices):
+    m = _build(offload=True, rows=1000)
+    before = m.get_parameter("emb", "weight").copy()
+    m.train_iteration()
+    m.sync()
+    after = m.get_parameter("emb", "weight")
+    touched = np.unique(np.asarray(m._host_idx["in_0"]
+                                   if "in_0" in m._host_idx else
+                                   next(iter(m._host_idx.values()))))
+    untouched = np.setdiff1d(np.arange(1000), touched)
+    assert untouched.size > 0
+    np.testing.assert_array_equal(before[untouched], after[untouched])
+    # and at least one touched row moved
+    assert np.abs(after[touched] - before[touched]).max() > 0
+
+
+def test_momentum_defaults_to_streaming(devices):
+    """Auto mode must NOT go sparse when the update rule touches every
+    row (SGD momentum decays untouched rows' buffers)."""
+    m = _build(offload=True, momentum=0.9)
+    assert "emb" not in m._host_embed
+    assert ("emb", "weight") in m._offload
+
+
+def test_forced_sparse_with_momentum_is_lazy(devices):
+    """sparse_host_embeddings=True opts into lazy per-touched-row
+    momentum (torch SparseAdam-style): still trains, table stays host."""
+    m = _build(offload=True, momentum=0.9, sparse=True)
+    assert "emb" in m._host_embed
+    assert isinstance(m._opt_state["v"]["emb"]["weight"], np.ndarray)
+    for _ in range(3):
+        m.train_iteration()
+    m.sync()
+    assert isinstance(m._params["emb"]["weight"], np.ndarray)
+
+
+def test_sparse_checkpoint_roundtrip(tmp_path, devices):
+    m = _build(offload=True)
+    for _ in range(2):
+        m.train_iteration()
+    m.sync()
+    w = m.get_parameter("emb", "weight").copy()
+    path = str(tmp_path / "ck.npz")
+    from flexflow_tpu.runtime.checkpoint import (load_checkpoint,
+                                                 save_checkpoint)
+    save_checkpoint(m, path)
+    m2 = _build(offload=True)
+    load_checkpoint(m2, path)
+    np.testing.assert_array_equal(w, m2.get_parameter("emb", "weight"))
+    # restored table is still host-resident numpy
+    assert isinstance(m2._params["emb"]["weight"], np.ndarray)
+    m2.train_iteration()
+    m2.sync()
+
+
+def test_eval_uses_sparse_gather(devices):
+    m = _build(offload=True)
+    m.train_iteration()
+    out = m.predict_batch()
+    assert out.shape[0] == 16
+    metrics = m.eval_batch()
+    assert "loss" in metrics
