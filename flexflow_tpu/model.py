@@ -1118,6 +1118,38 @@ class FFModel:
                          for opn, info in self._host_embed.items()}
             zero_specs = (self._zero_state_specs()
                           if self.config.zero_optimizer and multi else None)
+            if self.config.zero_optimizer and multi:
+                # ZeRO-1 eligibility is structural (leading dim unsharded
+                # and divisible over the free mesh axes) — report which
+                # state actually sharded so a silently-replicated slot is
+                # never mistaken for a sharded one.  Pipeline-packed,
+                # host-offloaded, and host-sparse weights are accounted
+                # as their own categories: packed stage state is sharded
+                # ~1/ring by the pipe buffer itself, and host-resident
+                # state never occupies device HBM at all.
+                eligible = zero_specs or {}
+                packed = set(pack["entries"]) if pack else set()
+                cats = {"packed(1/ring)": 0, "host": 0}
+                skipped = []
+                n_total = 0
+                for op in self.ops:
+                    for w in op.weights:
+                        k = (op.name, w.name)
+                        n_total += 1
+                        if op.param_key in packed:
+                            cats["packed(1/ring)"] += 1
+                        elif k in self._offload or op.name in self._host_embed:
+                            cats["host"] += 1
+                        elif k not in eligible:
+                            skipped.append(k)
+                extras = ", ".join(f"{n} {c}" for c, n in cats.items() if n)
+                print(f"flexflow_tpu: ZeRO-1 optimizer-state sharding: "
+                      f"{len(eligible)}/{n_total} weights sharded"
+                      + (f" (+{extras})" if extras else "")
+                      + (f"; replicated (ineligible): "
+                         f"{', '.join('/'.join(k) for k in skipped[:8])}"
+                         + ("..." if len(skipped) > 8 else "")
+                         if skipped else ""))
             if zero_specs:
                 # state spec != param spec breaks the fused kernels'
                 # same-spec shard_map; those leaves take the plain update

@@ -3,12 +3,22 @@
 # (which runs ~30 flexflow_python example invocations as the de-facto
 # suite).  Here: the pytest suite on a virtual 8-device CPU mesh, then
 # (with RUN_EXAMPLES=1) the example apps with VerifyMetrics assertions.
+#
+# Two gates:
+#   ./test.sh          fast gate — `-m "not slow"`, the default loop
+#   FULL=1 ./test.sh   everything, including slow integration tests
+# (tests/conftest.py enables the persistent XLA compile cache, so warm
+# re-runs are much faster than the first.)
 set -e
 cd "$(dirname "$0")"
 
 python -m flexflow_tpu.tools.doctor --skip-accelerator
 
-python -m pytest tests/ -q "$@"
+if [ -n "$FULL" ]; then
+  python -m pytest tests/ -q "$@"
+else
+  python -m pytest tests/ -q -m "not slow" "$@"
+fi
 
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \

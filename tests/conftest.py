@@ -20,6 +20,13 @@ import jax  # noqa: E402
 # virtual CPU mesh regardless.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: the suite's wall time is dominated by XLA
+# compiles of the fused SPMD train steps; a warm cache cuts re-runs by
+# minutes.  Keyed by HLO+flags, so code changes re-compile as needed.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("FF_TEST_JAX_CACHE", "/tmp/ff_test_jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 

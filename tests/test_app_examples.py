@@ -9,6 +9,7 @@ import pytest
 sys.path.insert(0, ".")
 
 
+@pytest.mark.slow
 def test_nmt_driver():
     from examples.nmt import main
 
@@ -25,12 +26,14 @@ def test_dlrm_driver():
           "--epochs", "1"])
 
 
+@pytest.mark.slow
 def test_pca_driver():
     from examples.pca import main
 
     main(["-b", "16"])
 
 
+@pytest.mark.slow
 def test_candle_uno_driver():
     from examples.candle_uno import main
 

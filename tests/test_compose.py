@@ -77,6 +77,7 @@ def test_fused_zero_stack(devices):
     assert st.sharding.spec and st.sharding.spec[0] is not None
 
 
+@pytest.mark.slow
 def test_pipeline_zero_stack(devices):
     """General pipeline (packed stage weights) + ZeRO-1: the pipe buffer
     keeps its pipe sharding, other leaves shard state over free axes,
@@ -102,6 +103,7 @@ def test_pipeline_zero_stack(devices):
     np.testing.assert_allclose(a0, a1, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_moe_grad_accum_ep(devices):
     """MoE under expert parallelism + grad accumulation == plain run.
     Routing is per-micro-batch deterministic (capacity depends on the
@@ -130,6 +132,7 @@ def test_moe_grad_accum_ep(devices):
     np.testing.assert_allclose(w0, w1, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_remat_grad_accum(devices):
     """GPipe pipeline x rematerialization x 2-way grad accumulation ==
     the plain run (the accum micro-loop wraps the ring schedule; remat

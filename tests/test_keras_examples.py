@@ -15,6 +15,7 @@ def test_seq_mnist_mlp():
     top_level_task(num_samples=512, epochs=2)
 
 
+@pytest.mark.slow
 def test_seq_mnist_cnn():
     from examples.keras.seq_mnist_cnn import top_level_task
 
@@ -27,6 +28,7 @@ def test_func_mnist_mlp_concat():
     top_level_task(num_samples=1024, epochs=6)
 
 
+@pytest.mark.slow
 def test_seq_reuters_mlp():
     from examples.keras.seq_reuters_mlp import top_level_task
 
@@ -46,6 +48,7 @@ def test_net2net_weight_transfer():
     top_level_task(num_samples=512, epochs=4)
 
 
+@pytest.mark.slow
 def test_candle_uno_builds_and_trains():
     import numpy as np
 
@@ -82,30 +85,35 @@ def test_candle_uno_builds_and_trains():
     assert losses[-1] < losses[0], f"MSE did not decrease: {losses[0]} -> {losses[-1]}"
 
 
+@pytest.mark.slow
 def test_func_mnist_mlp():
     from examples.keras.func_mnist_mlp import top_level_task
 
     top_level_task(num_samples=512, epochs=2)
 
 
+@pytest.mark.slow
 def test_func_mnist_cnn():
     from examples.keras.func_mnist_cnn import top_level_task
 
     top_level_task(num_samples=512, epochs=2)
 
 
+@pytest.mark.slow
 def test_func_mnist_cnn_concat():
     from examples.keras.func_mnist_cnn_concat import top_level_task
 
     top_level_task(num_samples=512, epochs=2)
 
 
+@pytest.mark.slow
 def test_func_mnist_mlp_concat2():
     from examples.keras.func_mnist_mlp_concat2 import top_level_task
 
     top_level_task(num_samples=512, epochs=4)
 
 
+@pytest.mark.slow
 def test_func_mnist_mlp_net2net():
     from examples.keras.func_mnist_mlp_net2net import top_level_task
 
@@ -145,12 +153,14 @@ def test_callback_lr_scheduler():
     top_level_task(num_samples=512, epochs=4)
 
 
+@pytest.mark.slow
 def test_seq_mnist_cnn_nested():
     from examples.keras.seq_mnist_cnn_nested import top_level_task
 
     top_level_task(num_samples=512, epochs=4)
 
 
+@pytest.mark.slow
 def test_seq_mnist_mlp_net2net():
     from examples.keras.seq_mnist_mlp_net2net import top_level_task
 
@@ -185,6 +195,7 @@ def test_func_cifar10_cnn_net2net():
     top_level_task(num_samples=512, epochs=4)
 
 
+@pytest.mark.slow
 def test_keras_candle_uno():
     # scaled-down towers, plus a second drug so the drug encoders are
     # genuinely SHARED across two inputs of the same feature type

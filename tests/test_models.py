@@ -26,6 +26,7 @@ def test_alexnet_topology(devices):
     assert len([o for o in m.ops if o._type == "Dense"]) == 3
 
 
+@pytest.mark.slow
 def test_inception_topology(devices):
     m = ff.FFModel(ff.FFConfig(batch_size=2))
     inp, out = build_inception_v3(m, 2)
@@ -37,6 +38,7 @@ def test_inception_topology(devices):
     assert pool_in.dims[3] == 2048  # InceptionE output channels 320+384*4+192
 
 
+@pytest.mark.slow
 def test_resnet50_trains_one_step(devices):
     m = ff.FFModel(ff.FFConfig(batch_size=8))
     inp, out = build_resnet50(m, 8, height=64, width=64)
@@ -100,6 +102,7 @@ def test_nmt_trains(devices):
     assert acc > 50.0, f"NMT failed to learn copy task: acc={acc}"
 
 
+@pytest.mark.slow
 def test_candle_uno_builds(devices):
     m = ff.FFModel(ff.FFConfig(batch_size=4))
     inputs, out = build_candle_uno(m, 4, dense_layers=[32] * 3,
@@ -117,6 +120,7 @@ def test_candle_uno_builds(devices):
     m.sync()
 
 
+@pytest.mark.slow
 def test_nmt_greedy_translate_matches_teacher_forced_oracle(devices):
     """LSTM decode carry (seeded from the encoder state at step 0) must
     reproduce the teacher-forced full-forward argmax chain."""

@@ -15,12 +15,14 @@ def test_mnist_mlp():
     assert top_level_task(["-e", "2", "-b", "64"], num_samples=512) >= 60.0
 
 
+@pytest.mark.slow
 def test_mnist_mlp_attach():
     from examples.native.mnist_mlp_attach import top_level_task
 
     assert top_level_task(["-e", "2", "-b", "64"], num_samples=512) >= 60.0
 
 
+@pytest.mark.slow
 def test_mnist_cnn():
     from examples.native.mnist_cnn import top_level_task
 
@@ -48,6 +50,7 @@ def test_cifar10_cnn_concat():
     assert top_level_task(["-b", "64"], num_samples=512, epochs=4) >= 30.0
 
 
+@pytest.mark.slow
 def test_alexnet_torch_one_step_parity():
     from examples.native.alexnet_torch import top_level_task
 
@@ -72,6 +75,7 @@ def test_tensor_attach():
     assert top_level_task([])
 
 
+@pytest.mark.slow
 def test_alexnet_new_v2_api():
     from examples.native.alexnet_new import top_level_task
 

@@ -61,6 +61,7 @@ def test_pipeline_mlp_numerics_vs_sequential(devices):
     np.testing.assert_allclose(h_ref, h_pp, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_mlp_dp_x_pp(devices):
     """dp x pp composition: batch split 2 ways x 4-deep pipeline."""
     k_ref, h_ref, _ = _train_pipeline_mlp(None)
@@ -85,6 +86,7 @@ def test_pipeline_mlp_legalize_pipe_degree(devices):
     assert m.get_strategies()["pipe"].dims == (1, 4)
 
 
+@pytest.mark.slow
 def test_pipeline_mlp_search_candidates_legal(devices):
     """Search-generated PipelineMLP candidates are legal after the op
     legalize hook (pipe degree divides num_stages)."""
@@ -148,6 +150,7 @@ def test_general_pipeline_heterogeneous_mlp(devices):
     np.testing.assert_allclose(b_ref, b_pp, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_general_pipeline_dp_x_pp(devices):
     """dp=2 x pp=4 over the 8-device mesh, microbatches per dp shard."""
     a_ref, b_ref, _ = _train_general(None)
@@ -157,6 +160,7 @@ def test_general_pipeline_dp_x_pp(devices):
     np.testing.assert_allclose(b_ref, b_pp, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_general_pipeline_explicit_stages(devices):
     """Explicit per-op stage lists (the nmt.cc:269-308 placement style)."""
     a_ref, b_ref, _ = _train_general(None)
@@ -167,6 +171,7 @@ def test_general_pipeline_explicit_stages(devices):
     np.testing.assert_allclose(b_ref, b_pp, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_general_pipeline_transformer(devices):
     """2-stage transformer (attention + layernorm + ffn per stage) —
     the VERDICT's 'pipeline a real model's heterogeneous layers' case."""
@@ -293,6 +298,7 @@ def test_general_pipeline_stage_weight_placement(devices):
     np.testing.assert_array_equal(m.get_parameter("fc2", "kernel"), 0.0)
 
 
+@pytest.mark.slow
 def test_general_pipeline_uneven_boundaries(devices):
     """Conv-heavy front stage vs tiny dense back stages: boundary
     buffers pad to the largest flattened boundary (conv activations),
@@ -337,6 +343,7 @@ def test_general_pipeline_uneven_boundaries(devices):
     np.testing.assert_allclose(f_ref, f_pp, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_graph_apply_bare_grad_uneven(devices):
     """jax.grad straight through pipeline_graph_apply with replicated
     params and strongly uneven boundaries — pins the wire-trimmed ring
@@ -380,6 +387,7 @@ def test_pipeline_graph_apply_bare_grad_uneven(devices):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_generate_on_pipelined_model(devices):
     """generate() on a pipeline-packed model: the decode runner walks
     ops sequentially, so the packed stage-weight buffer unpacks to

@@ -48,6 +48,7 @@ def test_blockwise_causal_offsets():
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode", ["ring", "ulysses"])
 @pytest.mark.parametrize("causal", [False, True])
 def test_sequence_parallel_matches_dense(devices, mode, causal):

@@ -78,6 +78,7 @@ def test_transformer_trains(devices):
     assert losses[-1] < losses[0] * 0.5, losses
 
 
+@pytest.mark.slow
 def test_transformer_4d_example(devices):
     """dp x sp x tp x ep in one graph (examples/transformer_4d.py)."""
     from examples.transformer_4d import top_level_task
@@ -131,6 +132,7 @@ def test_generate_matches_full_forward_oracle(devices):
     assert s1.shape == (B, N) and (s1 >= 0).all() and (s1 < V).all()
 
 
+@pytest.mark.slow
 def test_beam_search(devices):
     """beam_size=1 equals greedy generate; with K=V and N=2 the beam is
     exhaustive-optimal (verified by enumerating all V^2 continuations);
@@ -203,6 +205,7 @@ def test_beam_search(devices):
     assert checked > 0
 
 
+@pytest.mark.slow
 def test_generate_on_sharded_model(devices):
     """generate/beam_search on a model trained over the 8-device mesh
     with head-TP attention: the decode jit consumes the sharded params
@@ -239,6 +242,7 @@ def test_generate_on_sharded_model(devices):
     assert (np.diff(scores, axis=1) <= 1e-6).all()
 
 
+@pytest.mark.slow
 def test_beam_length_penalty_reranks(devices):
     """length_penalty re-ranks finished-short vs long beams by the GNMT
     normalization; raw scores stay untouched sums."""
@@ -269,6 +273,7 @@ def test_beam_length_penalty_reranks(devices):
         assert (np.diff(norm[fin]) <= 1e-6).all()
 
 
+@pytest.mark.slow
 def test_generate_bfloat16(devices):
     """The bench's decode config: kv caches and activations in bf16
     (argmax over f32-cast probs keeps token selection stable)."""
@@ -288,6 +293,7 @@ def test_generate_bfloat16(devices):
     assert out.shape == (4, 8) and (out >= 0).all() and (out < 50).all()
 
 
+@pytest.mark.slow
 def test_generate_top_k_top_p(devices):
     """top_k=1 sampling equals greedy for any temperature; top_p keeps
     sampled tokens inside the nucleus (checked against per-step
@@ -335,6 +341,7 @@ def test_generate_top_k_top_p(devices):
         seq = np.concatenate([seq, out[:, i:i + 1]], axis=1)
 
 
+@pytest.mark.slow
 def test_generate_compile_cache_reuse(devices):
     """New seeds/temperatures reuse the compiled decode scan (seed and
     temp are runtime arguments, not trace constants)."""
