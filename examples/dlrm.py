@@ -26,6 +26,7 @@ def main(argv=None):
     bag = 1
     mlp_bot = [64, 512, 512, 64]
     mlp_top = [576, 1024, 1024, 1024, 1]
+    host_embeddings = False
     i = 0
     while i < len(rest):
         if rest[i] == "--arch-embedding-size":
@@ -43,7 +44,20 @@ def main(argv=None):
         elif rest[i] == "--arch-mlp-top":
             i += 1
             mlp_top = [int(v) for v in rest[i].split("-")]
+        elif rest[i] == "--host-embeddings":
+            host_embeddings = True
         i += 1
+
+    if host_embeddings:
+        # Reference DLRM's hetero placement (dlrm_strategy_hetero.cc puts
+        # the 8x1M-row tables in host zero-copy memory): tables become
+        # host-resident and ROW-SPARSE — per step only the batch's unique
+        # rows move host<->device.  Applied after flag parsing so it
+        # covers the final table count regardless of flag order.
+        from flexflow_tpu.config import DeviceType
+        for j in range(len(emb_sizes)):
+            cfg.strategies[f"embedding{j}"] = ff.ParallelConfig(
+                DeviceType.CPU, (1, 1), (0,))
 
     print(f"batchSize({cfg.batch_size}) workersPerNodes({cfg.workers_per_node}) "
           f"numNodes({cfg.num_nodes})")
@@ -56,6 +70,11 @@ def main(argv=None):
                   ff.LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
                   [ff.MetricsType.ACCURACY, ff.MetricsType.MEAN_SQUARED_ERROR])
     model.init_layers()
+    if model._host_embed:
+        u = sum(info["u_max"] for info in model._host_embed.values())
+        total = sum(emb_sizes)
+        print(f"host-sparse embeddings: {len(model._host_embed)} tables "
+              f"({total:,} rows host-resident), <= {u} rows/step on the wire")
 
     sparse, dense, labels = synthetic_batch(cfg.batch_size, emb_sizes, bag, mlp_bot[0])
     inputs = {t: a for t, a in zip(sparse_in, sparse)}

@@ -12,14 +12,16 @@ costs seconds, not microseconds, so caching is mandatory and durable:
   * only REAL measurements are persisted, tagged with the platform they
     were taken on (``{"t": sec, "measured": true, "platform": "tpu"}``)
     so CPU-measured values can never masquerade as chip timings;
-  * a committed cache (``measured_v5e.json``, produced by
-    ``tools/calibrate.py`` on the real v5e) ships with the package, so
+  * WHEN ``measured_v5e.json`` exists (produced by
+    ``tools/calibrate.py`` on the real v5e; absent until a healthy-chip
+    calibration run lands — see CALIBRATION.md for current status),
     every search — including offline search on a CPU-only host — costs
     candidates with real chip timings where available;
   * anything uncached falls back to a roofline
     ``max(flops / (peak·eff), bytes / hbm_bw) + overhead`` whose
-    ``mxu_efficiency`` / overhead / backward-multiplier constants are
-    themselves fitted to the measurements (machine_v5e.json).
+    ``mxu_efficiency`` / overhead / backward-multiplier constants come
+    from ``machine_v5e.json`` when that fit exists, else the dataclass
+    DEFAULTS (every report states which — "fitted" vs "unfitted").
 """
 
 from __future__ import annotations

@@ -172,6 +172,18 @@ class Simulator:
                             synched.add(nxt)
                             group.append(nxt)
                     vol = int(np.prod([hi - lo + 1 for lo, hi in first_r]))
+                    if op._type == "Embedding":
+                        # An embedding's gradient is ROW-SPARSE: at most
+                        # the batch's rows are touched (reference
+                        # embedding.cc scatter-adds only those; real DP
+                        # backends sync sparse grads).  Pricing the full
+                        # table here would gift the searched strategy a
+                        # fantasy speedup over a DP baseline no backend
+                        # executes that way.
+                        rows = int(np.prod(op.inputs[0].dims))
+                        d_tile = (first_r[-1][1] - first_r[-1][0] + 1
+                                  if first_r else 1)
+                        vol = min(vol, rows * d_tile)
                     gdevs = [devs[g] for g in group]
                     # psum over the replica group: ring allreduce cost
                     # grad allreduce stays f32 (master weights/grads)

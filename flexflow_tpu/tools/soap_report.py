@@ -107,11 +107,24 @@ def main(argv: Optional[List[str]] = None):
     if args.export:
         save_strategies_to_file(args.export, best)
 
+    # "fitted" only when the machine model ACTUALLY loaded overrides —
+    # a present-but-corrupt machine_v5e.json silently falls back to the
+    # dataclass defaults and must not be labeled fitted
+    defaults = TPUMachineModel(num_devices=args.devices)
+    fitted = any(
+        getattr(mm, f) != getattr(defaults, f)
+        for f in ("mxu_efficiency", "hbm_bandwidth",
+                  "kernel_launch_overhead", "backward_multiplier"))
+    roofline = ("FITTED roofline (machine_v5e.json, constants fitted to "
+                "on-chip measurements)" if fitted else
+                "UNFITTED analytic roofline (dataclass defaults — "
+                "machine_v5e.json absent; run tools/calibrate.py on the "
+                "chip)")
     lines = [
         f"# SOAP search vs data parallel — {args.model}",
         "",
         f"Machine: simulated v5e, {args.devices} chips "
-        f"(torus {mm.torus[0]}x{mm.torus[1]}), calibrated roofline "
+        f"(torus {mm.torus[0]}x{mm.torus[1]}), {roofline} "
         f"(mxu_eff={mm.mxu_efficiency:.2f}, "
         f"hbm={mm.hbm_bandwidth / 1e9:.0f} GB/s, "
         f"ovh={mm.kernel_launch_overhead * 1e6:.1f} us, "
@@ -119,7 +132,8 @@ def main(argv: Optional[List[str]] = None):
         f"global batch {args.batch_size}, {args.compute_dtype}.",
         f"Cost provenance over the compared strategies: "
         f"{measured} op-times from REAL on-chip measurements "
-        f"(measured_v5e.json), {analytic} from the calibrated roofline.",
+        f"(measured_v5e.json), {analytic} from the "
+        f"{'fitted' if fitted else 'unfitted analytic'} roofline.",
         f"Search engine: {engine}, budget {args.budget} "
         f"(reference: FFModel::optimize MCMC, model.cc:1056-1107).",
         "",
