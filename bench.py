@@ -45,7 +45,10 @@ TRANSFORMER_VOCAB = 32000
 
 GLOBAL_BUDGET = 1080.0     # total wall seconds (driver kills somewhere ~25min)
 PHASE_BUDGETS = {          # per-phase wall seconds (incl. compile)
-    "alexnet": 480.0,      # + jax import + backend init over the tunnel
+    "preflight": 150.0,    # backend init + one tiny matmul: a wedged
+                           # tunnel fails the round HERE, in ~2.5 min,
+                           # instead of eating the alexnet budget
+    "alexnet": 480.0,
     "inception_v3": 240.0,
     "transformer": 240.0,
     "decode": 180.0,
@@ -55,8 +58,8 @@ PHASE_BUDGETS = {          # per-phase wall seconds (incl. compile)
 
 _t_start = time.monotonic()
 _state = {
-    "deadline": _t_start + PHASE_BUDGETS["alexnet"],
-    "phase": "alexnet",
+    "deadline": _t_start + PHASE_BUDGETS["preflight"],
+    "phase": "preflight",
     "primary_printed": False,
     "extra": {},
 }
@@ -333,11 +336,37 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     extra = _state["extra"]
 
-    # ---- primary phase: nothing runs before this number is on stdout ----
+    # ---- preflight: backend init + tiny matmul under a short deadline ----
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/flexflow_tpu_jax_cache")
+    import jax.numpy as jnp
+
+    t_pf = time.monotonic()
+    try:
+        jax.device_get((jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum())
+        plat = jax.devices()[0].platform
+        extra["preflight"] = {
+            "backend_init_s": round(time.monotonic() - t_pf, 1),
+            "platform": plat,
+            "device": str(jax.devices()[0].device_kind)}
+        if plat == "cpu" and not os.environ.get("FF_BENCH_ALLOW_CPU"):
+            # jax silently falls back to its CPU backend when the TPU
+            # plugin fails init — a CPU "samples/s/chip" number would be
+            # garbage against the TPU baseline; fail fast instead of
+            # burning the alexnet budget discovering it
+            raise RuntimeError(
+                "backend fell back to 'cpu' (TPU unreachable); set "
+                "FF_BENCH_ALLOW_CPU=1 for a structural CPU run")
+    except Exception as e:  # init failed fast — still emit the line
+        _emit_primary(None, extra,
+                      error=f"preflight: {type(e).__name__}: {e}")
+        _write_side_file()
+        raise
+
+    # ---- primary phase: nothing runs before this number is on stdout ----
+    _enter_phase("alexnet")
     try:
         sps_a, tf_a, mfu_a = run_one("alexnet", batch_size=256)
     except Exception as e:
