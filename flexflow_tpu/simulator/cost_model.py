@@ -33,6 +33,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..config import DeviceType
 from .machine import TPUMachineModel
 
 # Committed on-chip measurement cache, produced by tools/calibrate.py.
@@ -250,8 +251,29 @@ class CostModel:
                       f"({which}): {type(e).__name__}: {e}", file=sys.stderr)
             return None
 
+    # -- host-placed row-sparse embedding ---------------------------------
+    def _host_embedding_time(self, op, which: str) -> float:
+        """Row-sparse host-resident table (runtime:
+        FFModel._host_embed_swap_in; reference embedding.cc CPU tasks):
+        the host gathers the batch's rows from DDR and ships them over
+        PCIe; backward returns row grads and scatter-adds the update
+        host-side.  Per-step volume scales with the BATCH's rows, never
+        the table."""
+        m = self.machine
+        rows = int(np.prod(op.inputs[0].dims))  # global batch x bag
+        vol = 4.0 * rows * op.out_dim           # f32 rows on the wire
+        t = (vol / m.host_memory_bandwidth + vol / m.pcie_bandwidth
+             + m.kernel_launch_overhead)
+        if which == "backward":
+            # row grads back over PCIe + host scatter-add + state row update
+            t *= 2.0
+        return float(t)
+
     # -- public ------------------------------------------------------------
     def op_time(self, op, pc, which: str) -> float:
+        if getattr(pc, "device_type", None) == DeviceType.CPU \
+                and op._type == "Embedding":
+            return self._host_embedding_time(op, which)
         key = self._key(op, pc, which)
         if key in self._measured:
             self.stats["measured_hits"] += 1

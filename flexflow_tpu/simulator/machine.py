@@ -37,6 +37,10 @@ class TPUMachineModel:
     kernel_launch_overhead: float = 2e-6   # s; XLA per-fused-region overhead
     mxu_efficiency: float = 0.45      # achievable fraction of peak for convs/matmuls
     backward_multiplier: float = 2.0  # bwd ≈ dgrad + wgrad vs one fwd
+    # Host tier (row-sparse host-resident embeddings, reference hetero
+    # ZCM placement): chip<->host PCIe and host DDR stream bandwidth.
+    pcie_bandwidth: float = 32e9      # bytes/s per direction (gen4 x16)
+    host_memory_bandwidth: float = 100e9  # bytes/s effective DDR gather
 
     @classmethod
     def calibrated(cls, **kw) -> "TPUMachineModel":

@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..config import ParallelConfig
+from ..config import DeviceType, ParallelConfig
 from .cost_model import CostModel
 from .machine import TPUMachineModel
 
@@ -92,15 +92,21 @@ class Simulator:
                 op, "pc", None) or ParallelConfig.data_parallel(op.output.num_dims, nd)
             return model._legalize_pc(op, pc) if hasattr(model, "_legalize_pc") else pc
 
-        # Step 1: compute tasks
+        # Step 1: compute tasks.  Host-placed ops (row-sparse tables) run
+        # on the HOST timeline — one serial host device, matching the
+        # runtime's host-side gather/scatter — never on a chip's, so host
+        # DDR/PCIe time doesn't falsely contend with an arbitrary chip's
+        # compute.
         for li, op in enumerate(ops):
             pc = pc_of(op)
             devs = self._devices_of(pc)
+            on_host = getattr(pc, "device_type", None) == DeviceType.CPU
             ft = self.cost.op_time(op, pc, "forward")
             bt = self.cost.op_time(op, pc, "backward")
             for j in range(pc.num_parts()):
-                t1 = _Task(f"fwd:{op.name}:{j}", ("chip", devs[j]), ft)
-                t2 = _Task(f"bwd:{op.name}:{j}", ("chip", devs[j]), bt)
+                dev = ("host", 0) if on_host else ("chip", devs[j])
+                t1 = _Task(f"fwd:{op.name}:{j}", dev, ft)
+                t2 = _Task(f"bwd:{op.name}:{j}", dev, bt)
                 t1.add_next(t2)
                 fwd[(li, j)] = t1
                 bwd[(li, j)] = t2
@@ -108,6 +114,12 @@ class Simulator:
 
         def add_xfer(src: _Task, dst: _Task, volume: int):
             if volume <= 0:
+                return
+            if (src.device and src.device[0] == "host") or \
+                    (dst.device and dst.device[0] == "host"):
+                # host<->chip rows ride PCIe, already priced inside the
+                # host op's time — keep the dependency, add no ICI relay
+                src.add_next(dst)
                 return
             a = src.device[1] if src.device else 0
             b = dst.device[1] if dst.device else 0
@@ -156,6 +168,11 @@ class Simulator:
             if not op.weights:
                 continue
             pc = pc_of(op)
+            if getattr(pc, "device_type", None) == DeviceType.CPU:
+                # host-resident weights (row-sparse tables): the update
+                # is the host scatter-add already priced in the op's
+                # backward — no device-side grad allreduce exists
+                continue
             devs = self._devices_of(pc)
             for wi, w in enumerate(op.weights):
                 synched = set()
@@ -235,6 +252,8 @@ class Simulator:
                 return 1 << 40
             if dev[0] == "chip":
                 return dev[1]
+            if dev[0] == "host":  # serial host timeline (row-sparse tables)
+                return (1 << 30) + dev[1]
             return -(dev[1] * nd + dev[2] + 1)  # link (a, b)
 
         devices = [key(t.device) for t in tasks]

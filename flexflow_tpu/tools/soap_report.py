@@ -80,6 +80,17 @@ def main(argv: Optional[List[str]] = None):
 
     pipe_plan = search_pipeline(model, machine_model=mm)
 
+    # hetero host-embedding plan (reference dlrm_strategy_hetero.cc):
+    # tables host-resident ROW-SPARSE, everything else data-parallel
+    het_rt = None
+    if any(op._type == "Embedding" for op in model.ops):
+        from ..config import DeviceType
+        het = {op.name: (ParallelConfig(DeviceType.CPU, (1, 1), (0,),
+                                        ("host", "host", "host"))
+                         if op._type == "Embedding" else dp[op.name])
+               for op in model.ops}
+        het_rt = sim.simulate_runtime(model, het)
+
     # provenance: how much of the final strategies' costs are measured
     prov_cost = CostModel(mm, measure=False,
                           compute_dtype=args.compute_dtype)
@@ -152,6 +163,11 @@ def main(argv: Optional[List[str]] = None):
     else:
         lines.append("| pipeline plan | n/a (branching graph or no "
                      "executable partition) | |")
+    if het_rt is not None:
+        lines.append(
+            f"| hetero host-embedding (row-sparse tables, "
+            f"dlrm_strategy_hetero) | {het_rt * 1e3:.3f} ms | "
+            f"{dp_rt / het_rt:.2f}x |")
     lines.append("")
     if agree:
         lines += [

@@ -149,3 +149,31 @@ def test_compile_runs_search_with_budget(devices, tmp_path):
     m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy", ["accuracy"])
     loaded = ff.load_strategies_from_file(path)
     assert set(loaded) == {"c1", "f1", "d1", "s1"}
+
+
+def test_host_embedding_cost_scales_with_batch_not_table(devices):
+    """Host-placed (row-sparse) embedding pricing mirrors the runtime:
+    per-step cost follows the BATCH's rows, independent of table size
+    (reference: embedding.cc CPU tasks touch only the batch's rows)."""
+    from flexflow_tpu.config import DeviceType, ParallelConfig
+    from flexflow_tpu.simulator.cost_model import CostModel
+    from flexflow_tpu.simulator.machine import TPUMachineModel
+
+    def emb_op(batch, rows):
+        m = ff.FFModel(ff.FFConfig(batch_size=batch))
+        ids = m.create_tensor((batch, 2), dtype="int32", name="ids")
+        m.embedding(ids, rows, 16, name="emb")
+        return m.ops[0]
+
+    mm = TPUMachineModel(num_devices=8)
+    cost = CostModel(mm, measure=False)
+    cpu_pc = ParallelConfig(DeviceType.CPU, (1, 1), (0,),
+                            ("host", "host", "host"))
+    t_small = cost.op_time(emb_op(64, 10_000), cpu_pc, "forward")
+    t_large = cost.op_time(emb_op(64, 10_000_000), cpu_pc, "forward")
+    assert t_small == t_large  # table size is NOT in the cost
+    t_2x = cost.op_time(emb_op(128, 10_000), cpu_pc, "forward")
+    assert t_2x > t_small  # batch rows ARE
+    # backward adds the PCIe return + host scatter
+    t_bwd = cost.op_time(emb_op(64, 10_000), cpu_pc, "backward")
+    assert t_bwd > t_small
