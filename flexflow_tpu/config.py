@@ -71,6 +71,15 @@ class ParallelConfig:
         if any(d < 1 for d in self.dims):
             raise ValueError(f"partition degrees must be >= 1, got {self.dims}")
 
+    @classmethod
+    def host_rowsparse(cls) -> "ParallelConfig":
+        """Host placement for an embedding table (reference: the hetero
+        DLRM strategies' CPU + ZC-memory placement,
+        dlrm_strategy_hetero.cc:28-35) — the runtime's row-sparse
+        host-resident path.  ONE definition shared by the strategy
+        generators, both search engines, and the SOAP reports."""
+        return cls(DeviceType.CPU, (1, 1), (0,), ("host", "host", "host"))
+
     @property
     def ndims(self) -> int:
         return len(self.dims)

@@ -831,6 +831,40 @@ class FFModel:
     # parameter/state initialization (≈ FFModel::init_layers + initializer
     # tasks, src/runtime/initializer.cc)
     # ------------------------------------------------------------------
+    def _sparse_embed_structural_ok(self, op) -> bool:
+        """Structure-only part of row-sparse eligibility: an Embedding
+        with its own table fed straight from a graph input.  Shared with
+        the SEARCH paths (search.py / native_search.py propose host
+        candidates only for ops the runtime could actually execute
+        row-sparse — pricing a candidate batch-scaled and then executing
+        it table-scaled would make the search recommend regressions).
+        Deliberately does NOT touch ``jax.process_count()``: that
+        initializes the backend, and offline tools must never hang on a
+        wedged TPU tunnel for a structure question — the runtime check
+        in ``_sparse_embed_ok`` covers multi-process."""
+        return (isinstance(op, Embedding) and op.share_from is None
+                and any(op.inputs[0] is t for t in self.input_tensors))
+
+    def _sparse_embed_candidate_ok(self, op) -> bool:
+        """Search-time eligibility: structural checks plus the optimizer
+        check when an optimizer is already known (compile-time search);
+        an offline search with no optimizer assumes the built-in SGD
+        default."""
+        from .optimizers import AdamOptimizer, SGDOptimizer
+
+        if not self._sparse_embed_structural_ok(op):
+            return False
+        if self.optimizer is None:
+            return True
+        if not isinstance(self.optimizer, (SGDOptimizer, AdamOptimizer)):
+            return False
+        flag = getattr(self.config, "sparse_host_embeddings", None)
+        if flag is not None:
+            return bool(flag)
+        opt = self.optimizer
+        return (isinstance(opt, SGDOptimizer) and opt.momentum == 0.0
+                and opt.weight_decay == 0.0)
+
     def _sparse_embed_ok(self, op) -> bool:
         """Row-sparse host placement applies when the op is an Embedding
         with its own table fed straight from a graph input, in a single
@@ -842,9 +876,8 @@ class FFModel:
         SparseAdam-style) for momentum/Adam."""
         from .optimizers import AdamOptimizer, SGDOptimizer
 
-        if not (isinstance(op, Embedding) and op.share_from is None
+        if not (self._sparse_embed_structural_ok(op)
                 and jax.process_count() == 1
-                and any(op.inputs[0] is t for t in self.input_tensors)
                 and isinstance(self.optimizer, (SGDOptimizer, AdamOptimizer))):
             return False
         # Swap-in REMAPS the index input's batch values to the compact

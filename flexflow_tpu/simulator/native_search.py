@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..config import ParallelConfig
+from ..config import DeviceType, ParallelConfig
 from .cost_model import CostModel
 from .machine import TPUMachineModel
 from .search import _divisors, splittable_dims
@@ -46,10 +46,14 @@ def _factorizations(n: int, dims_avail: List[int], out_dims) -> List[Tuple[int, 
     return results
 
 
-def enumerate_candidates(op, nd: int) -> List[ParallelConfig]:
+def enumerate_candidates(op, nd: int, model=None) -> List[ParallelConfig]:
     """Deterministic enumeration of the same SOAP space the Python
     search samples randomly (search.py random_parallel_config), plus
-    block-aligned placements for sub-machine configs."""
+    block-aligned placements for sub-machine configs.  With ``model``,
+    also a HOST-placement candidate for embeddings the runtime can
+    execute row-sparse (reference: the hetero DLRM strategies hand-place
+    tables on CPU ZC memory, dlrm_strategy_hetero.cc; here the search
+    can DISCOVER that plan)."""
     rank = op.output.num_dims
     splittable = list(splittable_dims(op))
     seen = set()
@@ -64,6 +68,9 @@ def enumerate_candidates(op, nd: int) -> List[ParallelConfig]:
                     continue
                 seen.add(key)
                 cands.append(ParallelConfig(dims=degrees).with_device_ids(ids))
+    if model is not None and getattr(model, "_sparse_embed_candidate_ok",
+                                     lambda _: False)(op):
+        cands.append(ParallelConfig.host_rowsparse())
     return cands
 
 
@@ -80,7 +87,7 @@ def native_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
             ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
-            i32p, i32p, i32p, i32p,
+            i32p, i32p, i32p, i64p, i32p,
             i32p, i32p, f64p, f64p, i64p, i64p, i64p, i64p, i64p, i64p,
             ctypes.c_int32, ctypes.c_double, ctypes.c_uint64, ctypes.c_int32,
             i32p, i32p, f64p,
@@ -125,6 +132,9 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
     in_rank = np.zeros(L * max_inputs, np.int32)
     producer = np.full(L * max_inputs, -1, np.int32)
     w_rank = np.zeros(L * max_weights, np.int32)
+    # embeddings: grad sync touches at most the batch's rows (mirrors
+    # simulator.py's sparse clamp — ONE objective for both engines)
+    sync_rows_cap = np.full(L * max_weights, -1, np.int64)
     out_rank = np.zeros(L, np.int32)
 
     cand_lists: List[List[ParallelConfig]] = []
@@ -132,17 +142,22 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
         num_inputs[i] = len(op.inputs)
         num_weights[i] = len(op.weights)
         out_rank[i] = op.output.num_dims
+        if getattr(op, "_type", "") == "Embedding" and op.weights:
+            sync_rows_cap[i * max_weights] = int(
+                np.prod(op.inputs[0].dims))
         for j, tin in enumerate(op.inputs):
             pre = tin.owner_op
             producer[i * max_inputs + j] = (
                 op_index.get(id(pre), -1) if pre is not None else -1)
-        cands = enumerate_candidates(op, nd)
+        cands = enumerate_candidates(op, nd, model=model)
         cands = [model._legalize_pc(op, pc) if hasattr(model, "_legalize_pc")
                  else pc for pc in cands]
         # dedupe post-legalization, keep dp (full split of batch) first-known
+        # (device_type is part of the key: a host-placed (1,1) candidate
+        # must not collapse into the chip-0 (1,1) one)
         uniq, seen = [], set()
         for pc in cands:
-            key = (pc.dims, pc.device_ids[:pc.num_parts()])
+            key = (pc.device_type, pc.dims, pc.device_ids[:pc.num_parts()])
             if key not in seen:
                 seen.add(key)
                 uniq.append(pc)
@@ -181,6 +196,10 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
             ids = list(pc.device_ids[:P])
             if len(ids) < P:
                 ids = list(range(P))
+            if pc.device_type == DeviceType.CPU:
+                # host sentinel device (ffsearch.cpp host tier): its own
+                # serial timeline, PCIe priced inside the op cost
+                ids = [nd] * P
             parts_l.append(P)
             fwd_l.append(cost.op_time(op, pc, "forward"))
             bwd_l.append(cost.op_time(op, pc, "backward"))
@@ -213,6 +232,7 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
     a_in_rank = _as(in_rank, np.int32)
     a_producer = _as(producer, np.int32)
     a_w_rank = _as(w_rank, np.int32)
+    a_sync_cap = _as(sync_rows_cap, np.int64)
     a_out_rank = _as(out_rank, np.int32)
     a_cand_off = _as(cand_off, np.int32)
     a_parts = _as(parts_l, np.int32)
@@ -234,7 +254,8 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
         _ptr(a_num_weights, ctypes.c_int32),
         max_inputs, max_weights,
         _ptr(a_in_rank, ctypes.c_int32), _ptr(a_producer, ctypes.c_int32),
-        _ptr(a_w_rank, ctypes.c_int32), _ptr(a_out_rank, ctypes.c_int32),
+        _ptr(a_w_rank, ctypes.c_int32), _ptr(a_sync_cap, ctypes.c_int64),
+        _ptr(a_out_rank, ctypes.c_int32),
         _ptr(a_cand_off, ctypes.c_int32), _ptr(a_parts, ctypes.c_int32),
         _ptr(a_fwd, ctypes.c_double), _ptr(a_bwd, ctypes.c_double),
         _ptr(a_devices, ctypes.c_int64), _ptr(a_dev_off, ctypes.c_int64),

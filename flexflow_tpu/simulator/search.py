@@ -21,7 +21,7 @@ import math
 import random
 from typing import Dict, List, Optional
 
-from ..config import ParallelConfig
+from ..config import DeviceType, ParallelConfig
 from .cost_model import CostModel
 from .machine import TPUMachineModel
 from .simulator import Simulator
@@ -68,8 +68,17 @@ def splittable_dims(op) -> tuple:
     return tuple(out)
 
 
-def random_parallel_config(op, num_devices: int, rng: random.Random) -> ParallelConfig:
-    """Random legal SOAP config for ``op`` over ``num_devices`` chips."""
+def random_parallel_config(op, num_devices: int, rng: random.Random,
+                           model=None) -> ParallelConfig:
+    """Random legal SOAP config for ``op`` over ``num_devices`` chips.
+    With ``model``, eligible embeddings also propose HOST placement (the
+    row-sparse table path) with small probability — the searched space
+    covers the reference's hetero CPU placement instead of leaving it to
+    hand-written strategy files."""
+    if model is not None and rng.random() < 0.1 \
+            and getattr(model, "_sparse_embed_candidate_ok",
+                        lambda _: False)(op):
+        return ParallelConfig.host_rowsparse()
     rank = op.output.num_dims
     splittable = splittable_dims(op)
     num_parts = rng.choice(_divisors(num_devices))
@@ -131,7 +140,8 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
         # Legalize through the op hook so configs whose dims carry
         # non-size meaning (PipelineMLP pipe degree) are clamped against
         # the real bound before costing (same as the native engine path).
-        nxt[op.name] = op.legalize_pc(random_parallel_config(op, nd, rng))
+        nxt[op.name] = op.legalize_pc(
+            random_parallel_config(op, nd, rng, model=model))
         nxt_rt = sim.simulate_runtime(model, nxt)
         if verbose and it % 100 == 0:
             print(f"iter({it}) cur({current_rt * 1e3:.3f}ms) "

@@ -60,8 +60,9 @@ def generate_hetero(gpus: int = 1, cpus: int = 1,
     """Heterogeneous strategy: tables on host (dlrm_strategy_hetero.cc)."""
     out: Dict[str, ParallelConfig] = {}
     for i in range(num_embeddings):
+        base = ParallelConfig.host_rowsparse()
         out[f"embedding{i}"] = ParallelConfig(
-            DeviceType.CPU, (1, 1), (i % cpus,), ("host", "host", "host"))
+            base.device_type, base.dims, (i % cpus,), base.memory_types)
     for name in ("linear", "mse_loss", "concat"):
         out[name] = ParallelConfig(
             DeviceType.TPU, (1, gpus), tuple(range(gpus)))

@@ -84,6 +84,12 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
+    # zero-accelerator search (≈ reference scripts/simulator.cc): never
+    # init a TPU backend — the axon plugin ignores JAX_PLATFORMS
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
     from ..parallel.strategy import save_strategies_to_file
     from ..simulator.machine import TPUMachineModel
     from ..simulator.search import mcmc_search

@@ -41,6 +41,13 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--single-chip-batch", type=int, default=256)
     args = p.parse_args(argv)
 
+    # Pure simulation — never init (or hang on) a TPU backend from an
+    # offline report run; the axon plugin ignores JAX_PLATFORMS, so set
+    # the config directly.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
     from ..config import ParallelConfig
     from ..parallel.strategy import save_strategies_to_file
     from ..simulator.cost_model import CostModel
@@ -84,9 +91,7 @@ def main(argv: Optional[List[str]] = None):
     # tables host-resident ROW-SPARSE, everything else data-parallel
     het_rt = None
     if any(op._type == "Embedding" for op in model.ops):
-        from ..config import DeviceType
-        het = {op.name: (ParallelConfig(DeviceType.CPU, (1, 1), (0,),
-                                        ("host", "host", "host"))
+        het = {op.name: (ParallelConfig.host_rowsparse()
                          if op._type == "Embedding" else dp[op.name])
                for op in model.ops}
         het_rt = sim.simulate_runtime(model, het)
@@ -180,9 +185,13 @@ def main(argv: Optional[List[str]] = None):
         ]
     lines += ["## Searched per-op strategies", "",
               "| op | dims | parts |", "|---|---|---|"]
+    from ..config import DeviceType as _DT
     for op in model.ops:
         pc = best[op.name]
-        mark = "" if pc.dims == dp[op.name].dims else " **(non-DP)**"
+        if pc.device_type == _DT.CPU:
+            mark = " **(HOST row-sparse)**"
+        else:
+            mark = "" if pc.dims == dp[op.name].dims else " **(non-DP)**"
         lines.append(f"| {op.name} | {list(pc.dims)}{mark} | "
                      f"{pc.num_parts()} |")
     lines.append("")

@@ -83,6 +83,11 @@ struct OpDesc {
   std::vector<int32_t> in_rank;      // rank of each input's rects
   std::vector<int32_t> w_rank;       // rank of each weight tile
   std::vector<int32_t> producer;     // producing op index per input, -1 if graph input
+  // Row-sparse grad-sync clamp per weight (embeddings: the gradient
+  // touches at most the batch's rows — simulator.py's clamp, mirrored
+  // here so both engines share one objective).  -1 = no clamp; else the
+  // batch's index count, multiplied by the tile's last-dim extent.
+  std::vector<int64_t> sync_rows_cap;
   std::vector<Candidate> cands;
 };
 
@@ -108,6 +113,15 @@ struct Sim {
   std::vector<int64_t> device;   // chip id >= 0; links < 0; barrier uses chip
   std::vector<int32_t> edge_src, edge_dst;
 
+  // Host tier (row-sparse host-resident embedding tables): the Python
+  // marshaller encodes host placement as device id == num_devices; host
+  // tasks run on their own serial timeline, host<->chip bytes ride PCIe
+  // priced INSIDE the op's cost (no link task), and host-resident
+  // weights need no device allreduce — mirroring simulator.py exactly.
+  int host_id() const { return m->num_devices; }
+  bool is_host(int d) const { return d >= m->num_devices; }
+  int norm(int d) const { return is_host(d) ? host_id() : d % m->num_devices; }
+
   int add_task(double rt, int64_t dev) {
     run_time.push_back(rt);
     device.push_back(dev);
@@ -123,7 +137,10 @@ struct Sim {
   }
   void xfer(int src_task, int dst_task, int a, int b, int64_t vol) {
     if (vol <= 0) return;
-    if (a == b) { add_edge(src_task, dst_task); return; }
+    if (a == b || is_host(a) || is_host(b)) {
+      add_edge(src_task, dst_task);
+      return;
+    }
     double tt = m->transfer_time(a, b, m->elem_bytes * double(vol));
     int c = add_task(tt, link_key(a, b));
     add_edge(src_task, c);
@@ -141,7 +158,7 @@ struct Sim {
       fwd[i].resize(c.parts);
       bwd[i].resize(c.parts);
       for (int p = 0; p < c.parts; p++) {
-        int dev = c.devices[p] % m->num_devices;
+        int dev = norm(c.devices[p]);
         fwd[i][p] = add_task(c.fwd_cost, dev);
         bwd[i][p] = add_task(c.bwd_cost, dev);
         add_edge(fwd[i][p], bwd[i][p]);
@@ -160,12 +177,12 @@ struct Sim {
         const int64_t* src_rects = pcand.out_tiles;
         for (int dp = 0; dp < c.parts; dp++) {
           const int64_t* dr = dst_rects + size_t(dp) * rank * 2;
-          int ddev = c.devices[dp] % m->num_devices;
+          int ddev = norm(c.devices[dp]);
           for (int sp = 0; sp < pcand.parts; sp++) {
             const int64_t* sr = src_rects + size_t(sp) * rank * 2;
             int64_t vol = intersect(dr, sr, rank);
             if (vol > 0) {
-              int sdev = pcand.devices[sp] % m->num_devices;
+              int sdev = norm(pcand.devices[sp]);
               xfer(fwd[pi][sp], fwd[i][dp], sdev, ddev, vol);
               xfer(bwd[i][dp], bwd[pi][sp], ddev, sdev, vol);
             }
@@ -181,8 +198,14 @@ struct Sim {
         barrier[d] = add_task(0.0, d);
       for (size_t i = 0; i < L; i++) {
         const Candidate& c = O[i].cands[choice[i]];
-        for (int p = 0; p < c.parts; p++)
-          add_edge(bwd[i][p], barrier[c.devices[p] % m->num_devices]);
+        for (int p = 0; p < c.parts; p++) {
+          // host parts sync at chip 0's barrier (simulator.py wires the
+          // host bwd to barriers[device_ids[p] % nd], which is 0 for the
+          // host candidates the marshaller emits)
+          int b = is_host(c.devices[p]) ? 0
+                                        : c.devices[p] % m->num_devices;
+          add_edge(bwd[i][p], barrier[b]);
+        }
       }
     }
     std::vector<char> synched;
@@ -190,6 +213,8 @@ struct Sim {
     for (size_t i = 0; i < L; i++) {
       const OpDesc& od = O[i];
       const Candidate& c = od.cands[choice[i]];
+      if (c.parts > 0 && is_host(c.devices[0]))
+        continue;  // host-resident weights: update is the host scatter
       for (size_t w = 0; w < od.w_rank.size(); w++) {
         int rank = od.w_rank[w];
         const int64_t* tiles = c.w_tiles[w];
@@ -209,6 +234,12 @@ struct Sim {
           }
           int64_t vol = 1;
           for (int d = 0; d < rank; d++) vol *= fr[2 * d + 1] - fr[2 * d] + 1;
+          int64_t cap_rows = od.sync_rows_cap[w];
+          if (cap_rows >= 0) {
+            int64_t d_tile =
+                rank > 0 ? fr[2 * (rank - 1) + 1] - fr[2 * (rank - 1)] + 1 : 1;
+            vol = std::min(vol, cap_rows * d_tile);
+          }
           std::vector<int> gdevs;
           for (int g : group) gdevs.push_back(c.devices[g] % m->num_devices);
           double art = m->allreduce_time(gdevs, 4.0 * double(vol));
@@ -300,6 +331,7 @@ double ffsearch_anneal(
     const int32_t* in_rank,    // [L*max_inputs]
     const int32_t* producer,   // [L*max_inputs]
     const int32_t* w_rank,     // [L*max_weights]
+    const int64_t* sync_rows_cap,  // [L*max_weights]; -1 = no clamp
     const int32_t* out_rank,   // [L]
     // candidates
     const int32_t* cand_off,   // [L+1]
@@ -336,8 +368,10 @@ double ffsearch_anneal(
       od.in_rank.push_back(in_rank[i * max_inputs + j]);
       od.producer.push_back(producer[i * max_inputs + j]);
     }
-    for (int32_t w = 0; w < num_weights[i]; w++)
+    for (int32_t w = 0; w < num_weights[i]; w++) {
       od.w_rank.push_back(w_rank[i * max_weights + w]);
+      od.sync_rows_cap.push_back(sync_rows_cap[i * max_weights + w]);
+    }
     for (int32_t g = cand_off[i]; g < cand_off[i + 1]; g++) {
       Candidate c;
       c.parts = parts[g];

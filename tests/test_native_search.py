@@ -94,3 +94,40 @@ def test_dlrm_native_search_runs():
     assert best_rt <= dp_rt
     assert best_rt == pytest.approx(sim.simulate_runtime(model, best),
                                     rel=1e-9)
+
+
+def test_host_placement_in_searched_space(devices):
+    """Embedding ops carry a HOST-placement candidate (the reference's
+    hetero CPU strategy, dlrm_strategy_hetero.cc) — the search can
+    discover what the reference hand-writes; Dense ops don't."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.config import DeviceType
+
+    cfg = ff.FFConfig(batch_size=32, workers_per_node=8)
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((32, 2), dtype="int32", name="ids")
+    t = m.embedding(ids, 500_000, 16, name="emb")
+    t = m.dense(t, 8, name="head")
+    m.softmax(t, name="sm")
+
+    emb, head = m.ops[0], m.ops[1]
+    assert any(pc.device_type == DeviceType.CPU
+               for pc in enumerate_candidates(emb, 8, model=m))
+    assert not any(pc.device_type == DeviceType.CPU
+                   for pc in enumerate_candidates(head, 8, model=m))
+    # without a model the enumeration is chip-only (calibration jobs)
+    assert not any(pc.device_type == DeviceType.CPU
+                   for pc in enumerate_candidates(emb, 8))
+
+    # the native annealer consumes the enlarged space; for a 500k-row
+    # table at batch 32 the host row-sparse plan dominates, and the
+    # search DISCOVERS it (the reference hand-writes this placement,
+    # dlrm_strategy_hetero.cc)
+    r = native_mcmc_search(m, budget=600,
+                           machine_model=TPUMachineModel(num_devices=8),
+                           seed=0, verbose=False)
+    if r is not None:  # native lib present
+        best, best_rt, dp_rt = r
+        assert set(best) == {"emb", "head", "sm"}
+        assert best["emb"].device_type == DeviceType.CPU
+        assert best_rt < dp_rt
