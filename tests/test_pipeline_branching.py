@@ -87,3 +87,54 @@ def test_pipeline_search_prices_branching_graph(devices):
     assert plan is not None
     assert plan["num_stages"] >= 2
     assert np.isfinite(plan["simulated_s"]) and plan["simulated_s"] > 0
+
+
+@pytest.mark.slow
+def test_conv_branching_pipeline_matches_plain(devices):
+    """Inception-style stage: parallel CONV branches joined by a concat,
+    pipelined with rank-3 activations riding flattened hops — numerics
+    match the plain run (reference: inception ops pipelined by per-op
+    GPU placement like any others, src/mapper/mapper.cc)."""
+    def build(pipeline):
+        cfg = ff.FFConfig(batch_size=8)
+        m = ff.FFModel(cfg)
+        inp = m.create_tensor((8, 3, 12, 12), name="img")
+        t = m.conv2d(inp, 8, 3, 3, 1, 1, 1, 1, activation="relu",
+                     name="stem")
+        # two parallel branches off the stem (the inception_a shape)
+        b1 = m.conv2d(t, 8, 1, 1, 1, 1, 0, 0, activation="relu", name="b1")
+        b2 = m.conv2d(t, 8, 3, 3, 1, 1, 1, 1, activation="relu", name="b2")
+        z = m.concat([b1, b2], axis=1, name="mix")
+        t = m.pool2d(z, 2, 2, 2, 2, 0, 0, name="pool")
+        t = m.flat(t, name="flat")
+        t = m.dense(t, 4, name="head")
+        m.softmax(t, name="sm")
+        if pipeline:
+            m.set_pipeline(stages=[["stem"], ["b1", "b2"],
+                                   ["mix", "pool"], ["flat", "head"]],
+                           num_microbatches=4, degree=4, dp_degree=2)
+        m.compile(ff.SGDOptimizer(m, lr=0.1),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+        m.init_layers(seed=5)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 12, 12, 3)).astype(np.float32)
+        y = rng.integers(0, 4, (8, 1)).astype(np.int32)
+        m.set_batch({inp: x}, y)
+        for _ in range(3):
+            m.train_iteration()
+        m.sync()
+        return m
+
+    m_plain = build(False)
+    m_pipe = build(True)
+    plan = m_pipe._pipeline_plan
+    assert plan is not None
+    # hop 1 (branches -> mix) carries BOTH branch outputs
+    assert len(plan["boundaries"][1]) == 2
+    for opn, wn in [("stem", "kernel"), ("b1", "kernel"),
+                    ("b2", "kernel"), ("head", "kernel")]:
+        np.testing.assert_allclose(
+            m_plain.get_parameter(opn, wn), m_pipe.get_parameter(opn, wn),
+            rtol=3e-4, atol=3e-5,
+            err_msg=f"{opn}/{wn} diverged between plain and pipelined run")
