@@ -259,6 +259,12 @@ class FFConfig:
                 self.grad_accum_steps = int(take())
             elif a == "--remat":
                 self.remat = True
+            elif a == "--sparse-host-embeddings":
+                # force lazy row-sparse host tables even under
+                # momentum/Adam (auto mode only sparsifies plain SGD)
+                self.sparse_host_embeddings = True
+            elif a == "--no-sparse-host-embeddings":
+                self.sparse_host_embeddings = False
             else:
                 rest.append(a)
             i += 1
