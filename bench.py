@@ -187,11 +187,12 @@ def run_one(name, batch_size=256, compute_dtype="bfloat16", steps=24,
     return sps, tflops, tflops * 1e12 / PEAK_FLOPS
 
 
-def run_dlrm_host(batch_size=64, steps=8, tables=8, rows=1_000_000):
-    """Reference-config DLRM (8x1M-row tables, run_random.sh) with the
-    tables host-resident via the ROW-SPARSE path: per step only the
-    batch's unique rows cross the PCIe/tunnel boundary, not the 2 GB of
-    tables (reference: embedding.cc CPU tasks + dlrm_strategy_hetero.cc)."""
+def run_dlrm_host(batch_size=256, steps=8, tables=8, rows=1_000_000):
+    """Reference-config DLRM (bs 256/device, 8x1M-row tables —
+    run_random.sh:3-8) with the tables host-resident via the ROW-SPARSE
+    path: per step only the batch's unique rows cross the PCIe/tunnel
+    boundary, not the 2 GB of tables (reference: embedding.cc CPU tasks
+    + dlrm_strategy_hetero.cc)."""
     import flexflow_tpu as ff
     from flexflow_tpu.config import DeviceType
     from flexflow_tpu.models.dlrm import build_dlrm, synthetic_batch

@@ -79,3 +79,34 @@ def test_dlrm_trains_with_generated_strategy(devices, tmp_path):
     for _ in range(3):
         m.train_iteration()
     m.sync()
+
+
+def test_hetero_strategy_file_drives_row_sparse_runtime(devices, tmp_path):
+    """End-to-end parity story: a reference-wire-format HETERO strategy
+    file (dlrm_strategy_hetero.cc's output shape) imported into compile
+    routes the tables onto the row-sparse host-resident path — the
+    file a reference user already has drives the TPU-native feature."""
+    out = str(tmp_path / "h.pb")
+    dlrm_strategy.main(["--hetero", "--gpu", "8", "--emb", "4", "-o", out])
+    sizes = [64] * 4
+    cfg = ff.FFConfig(batch_size=16, compute_dtype="float32",
+                      import_strategy_file=out,
+                      import_strategy_reference_order=True)
+    m = ff.FFModel(cfg)
+    sparse, dense, p = build_dlrm(m, 16, embedding_sizes=sizes,
+                                  embedding_bag_size=2,
+                                  sparse_feature_size=8,
+                                  mlp_bot=[8, 16, 8],
+                                  mlp_top=[8 * 5, 16, 1])
+    m.compile(ff.SGDOptimizer(lr=0.05),
+              ff.LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+              [ff.MetricsType.MEAN_SQUARED_ERROR])
+    m.init_layers()
+    # all four tables took the row-sparse host path (numpy tables)
+    assert len(m._host_embed) == 4, m._host_embed
+    assert isinstance(m._params["embedding0"]["weight"], np.ndarray)
+    xs, xd, y = synthetic_batch(16, sizes, 2, 8)
+    m.set_batch({t: a for t, a in zip(sparse + [dense], xs + [xd])}, y)
+    for _ in range(3):
+        m.train_iteration()
+    m.sync()
