@@ -188,8 +188,9 @@ def run_one(name, batch_size=256, compute_dtype="bfloat16", steps=24,
 
 
 def run_dlrm_host(batch_size=256, steps=8, tables=8, rows=1_000_000):
-    """Reference-config DLRM (bs 256/device, 8x1M-row tables —
-    run_random.sh:3-8) with the tables host-resident via the ROW-SPARSE
+    """Reference-config DLRM (global batch 256 — on the single bench
+    chip that is the reference's 256/GPU, run_random.sh:3-8 — with
+    8x1M-row tables) and the tables host-resident via the ROW-SPARSE
     path: per step only the batch's unique rows cross the PCIe/tunnel
     boundary, not the 2 GB of tables (reference: embedding.cc CPU tasks
     + dlrm_strategy_hetero.cc)."""

@@ -74,3 +74,24 @@ def test_capi_smoke():
                          capture_output=True, timeout=300, text=True)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "C API smoke test: OK" in out.stdout
+
+
+def test_native_matches_python_engine_host_tier(devices):
+    """ffsim parity must hold for the HOST device tier too (row-sparse
+    tables: host timeline tasks + no-link host<->chip edges)."""
+    m = ff.FFModel(ff.FFConfig(batch_size=32))
+    ids = m.create_tensor((32, 2), dtype="int32", name="ids")
+    t = m.embedding(ids, 10_000, 16, name="emb")
+    t = m.dense(t, 8, name="head")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    mm = TPUMachineModel(num_devices=8)
+    sim = Simulator(mm, CostModel(mm, measure=False))
+    strategies = {op.name: ParallelConfig.data_parallel(op.output.num_dims, 8)
+                  for op in m.ops}
+    strategies["emb"] = ParallelConfig.host_rowsparse()
+    t_native = sim.simulate_runtime(m, strategies)
+    sim._simulate_native = lambda tasks: None
+    t_python = sim.simulate_runtime(m, strategies)
+    assert t_native == pytest.approx(t_python, rel=1e-9)
