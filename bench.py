@@ -163,18 +163,29 @@ def _fwd_flops_per_sample(model):
     return sum(op.flops_per_sample() for op in model.ops)
 
 
+def _build_warm(name, batch_size, compute_dtype, fused=False):
+    """Build + compile + warmup: two steps — the first step's outputs
+    carry committed shardings the initial arrays lacked, so step two
+    triggers one more (final) compilation before the shapes/shardings
+    fixpoint.  One definition for the bench loop, the sweep, and the
+    profiler so they always measure the same configuration."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/flexflow_tpu_jax_cache")
+    model = _build(name, batch_size, compute_dtype, fused=fused)
+    model.train_iteration()
+    model.train_iteration()
+    model.sync()
+    return model
+
+
 def run_one(name, batch_size=256, compute_dtype="bfloat16", steps=24,
             fused=False):
     """(samples/s/chip, achieved TFLOPS, MFU) for one model's train loop."""
     import jax
 
-    model = _build(name, batch_size, compute_dtype, fused=fused)
-    # Compile + warmup: two steps — the first step's outputs carry
-    # committed shardings the initial arrays lacked, so step two triggers
-    # one more (final) compilation before the shapes/shardings fixpoint.
-    model.train_iteration()
-    model.train_iteration()
-    model.sync()
+    model = _build_warm(name, batch_size, compute_dtype, fused=fused)
     t0 = time.perf_counter()
     for _ in range(steps):
         model.train_iteration()
@@ -236,8 +247,6 @@ def sweep(out="BENCH_SWEEP.md"):
     Writes the markdown table the single-number bench can't carry."""
     import jax
 
-    jax.config.update("jax_compilation_cache_dir",
-                      "/tmp/flexflow_tpu_jax_cache")
     lines = [f"# Throughput sweep — {jax.devices()[0].device_kind}",
              "",
              "| model | dtype | batch/chip | samples/s/chip | MFU |",
@@ -330,9 +339,30 @@ def _extra_phases(extra):
     _write_side_file()
 
 
+def profile(out="/tmp/flexflow_tpu_trace"):
+    """Capture an XLA profiler trace of the timed AlexNet loop (manual
+    mode: `python bench.py --profile [logdir]`) — the input to the
+    measured-optimization work: kernel timeline, HBM traffic, fusion
+    boundaries (view with TensorBoard or xprof)."""
+    from flexflow_tpu.runtime.profiling import trace
+
+    model = _build_warm("alexnet", 256, "bfloat16")
+    with trace(out):
+        for _ in range(8):
+            model.train_iteration()
+        model.sync()
+    print(f"-> trace in {out} (tensorboard --logdir {out})")
+
+
 def main():
     if "--sweep" in sys.argv:
         sweep()
+        return
+    if "--profile" in sys.argv:
+        idx = sys.argv.index("--profile")
+        out = (sys.argv[idx + 1] if len(sys.argv) > idx + 1
+               else "/tmp/flexflow_tpu_trace")
+        profile(out)
         return
 
     threading.Thread(target=_watchdog, daemon=True).start()

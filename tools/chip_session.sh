@@ -45,4 +45,11 @@ if [ -z "$SKIP_SWEEP" ]; then
   timeout 1800 python bench.py --sweep || true
 fi
 
+# 6. XLA profiler trace of the AlexNet step (the input to the measured
+# optimization work: kernel timeline, HBM traffic, fusion boundaries).
+# Cleared first — a stale trace from an earlier window must not pose as
+# this build's kernel timeline.
+rm -rf /tmp/flexflow_tpu_trace
+timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
+
 echo "chip_session: done"
