@@ -81,6 +81,17 @@ class ParallelConfig:
         return cls(DeviceType.CPU, (1, 1), (0,), ("host", "host", "host"))
 
     @property
+    def host_placed(self) -> bool:
+        """True when this config requests host placement: CPU device
+        type, or ANY region's memory type marked "host" (the runtime
+        treats either as "weights live host-side" — model.py offload /
+        row-sparse paths).  The SIMULATOR's host-tier pricing applies
+        this only to Embedding ops (the row-sparse path); other
+        host-placed ops stream weights but still compute on device."""
+        return self.device_type == DeviceType.CPU \
+            or "host" in self.memory_types
+
+    @property
     def ndims(self) -> int:
         return len(self.dims)
 

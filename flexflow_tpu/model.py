@@ -65,6 +65,21 @@ class LayerHandle:
         return self._build(ffmodel, input_tensor)
 
 
+def _copy_params_tree(tree):
+    """Shallow per-op copy of a params-shaped tree so callers can swap
+    individual weight leaves without mutating the caller's tree."""
+    return {k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in tree.items()}
+
+
+def _copy_state_tree(state):
+    """Shallow copy of an optimizer-state tree (slot -> params-shaped
+    subtree), one level deeper than ``_copy_params_tree``."""
+    return {k: ({opn: dict(ws) for opn, ws in v.items()}
+                if isinstance(v, dict) else v)
+            for k, v in state.items()}
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
@@ -887,10 +902,8 @@ class FFModel:
         idx_t = op.inputs[0]
         for o in self.ops:
             if any(t is idx_t for t in o.inputs):
-                o_host = (o.pc.device_type == DeviceType.CPU
-                          or "host" in o.pc.memory_types)
                 if not (isinstance(o, Embedding) and o.share_from is None
-                        and o_host):
+                        and o.pc.host_placed):
                     return False
         flag = getattr(self.config, "sparse_host_embeddings", None)
         if flag is not None:
@@ -928,8 +941,7 @@ class FFModel:
                 while entries and entries[-1] is None:
                     entries.pop()
                 sh = NamedSharding(self.machine.mesh, PartitionSpec(*entries))
-                host_placed = (op.pc.device_type == DeviceType.CPU
-                               or "host" in op.pc.memory_types)
+                host_placed = op.pc.host_placed
                 if host_placed and self._sparse_embed_ok(op):
                     # Row-sparse path (reference: embedding.cc:18-77 CPU
                     # tasks + dlrm_strategy_hetero.cc host ZC tables):
@@ -990,13 +1002,10 @@ class FFModel:
         then IS the lazy per-touched-row update, and
         ``_host_embed_scatter_back`` writes the rows home in place."""
         rep = self.machine.replicated()
-        params_in = {k: (dict(v) if isinstance(v, dict) else v)
-                     for k, v in params_in.items()}
+        params_in = _copy_params_tree(params_in)
         batch_in = dict(batch)
         if opt_in is not None:
-            opt_in = {k: ({opn: dict(ws) for opn, ws in v.items()}
-                          if isinstance(v, dict) else v)
-                      for k, v in opt_in.items()}
+            opt_in = _copy_state_tree(opt_in)
         ctxs = []
         for opn, info in self._host_embed.items():
             wn = info["weight"]
@@ -1034,12 +1043,9 @@ class FFModel:
         """Write each table's updated rows (and optimizer-state rows)
         back into the host arrays in place; the returned trees carry the
         full host tables again."""
-        new_params = {k: (dict(v) if isinstance(v, dict) else v)
-                      for k, v in new_params.items()}
+        new_params = _copy_params_tree(new_params)
         if new_opt is not None:
-            new_opt = {k: ({opn: dict(ws) for opn, ws in v.items()}
-                           if isinstance(v, dict) else v)
-                       for k, v in new_opt.items()}
+            new_opt = _copy_state_tree(new_opt)
         for ctx in ctxs:
             opn, wn, n = ctx["op"], ctx["weight"], ctx["n"]
             uniq, table = ctx["uniq"], ctx["table"]

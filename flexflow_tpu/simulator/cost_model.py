@@ -33,7 +33,6 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..config import DeviceType
 from .machine import TPUMachineModel
 
 # Committed on-chip measurement cache, produced by tools/calibrate.py.
@@ -271,8 +270,7 @@ class CostModel:
 
     # -- public ------------------------------------------------------------
     def op_time(self, op, pc, which: str) -> float:
-        if getattr(pc, "device_type", None) == DeviceType.CPU \
-                and op._type == "Embedding":
+        if pc is not None and pc.host_placed and op._type == "Embedding":
             return self._host_embedding_time(op, which)
         key = self._key(op, pc, which)
         if key in self._measured:

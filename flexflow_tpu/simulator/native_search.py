@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..config import DeviceType, ParallelConfig
+from ..config import ParallelConfig
 from .cost_model import CostModel
 from .machine import TPUMachineModel
 from .search import _divisors, splittable_dims
@@ -196,9 +196,10 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
             ids = list(pc.device_ids[:P])
             if len(ids) < P:
                 ids = list(range(P))
-            if pc.device_type == DeviceType.CPU:
+            if pc.host_placed and getattr(op, "_type", "") == "Embedding":
                 # host sentinel device (ffsearch.cpp host tier): its own
-                # serial timeline, PCIe priced inside the op cost
+                # serial timeline, PCIe priced inside the op cost — only
+                # the row-sparse embedding path computes host-side
                 ids = [nd] * P
             parts_l.append(P)
             fwd_l.append(cost.op_time(op, pc, "forward"))

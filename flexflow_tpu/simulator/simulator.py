@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..config import DeviceType, ParallelConfig
+from ..config import ParallelConfig
 from .cost_model import CostModel
 from .machine import TPUMachineModel
 
@@ -92,15 +92,20 @@ class Simulator:
                 op, "pc", None) or ParallelConfig.data_parallel(op.output.num_dims, nd)
             return model._legalize_pc(op, pc) if hasattr(model, "_legalize_pc") else pc
 
-        # Step 1: compute tasks.  Host-placed ops (row-sparse tables) run
-        # on the HOST timeline — one serial host device, matching the
-        # runtime's host-side gather/scatter — never on a chip's, so host
-        # DDR/PCIe time doesn't falsely contend with an arbitrary chip's
-        # compute.
+        # Step 1: compute tasks.  Host-placed EMBEDDINGS (the row-sparse
+        # table path — the only ops whose compute actually runs host-side)
+        # go on the HOST timeline: one serial host device, matching the
+        # runtime's host gather/scatter, so host DDR/PCIe time doesn't
+        # falsely contend with an arbitrary chip's compute.  Other
+        # host-placed ops stream weights but compute ON DEVICE (model.py
+        # offload path) and stay on their chips here.
+        def host_sparse(op, pc):
+            return pc.host_placed and op._type == "Embedding"
+
         for li, op in enumerate(ops):
             pc = pc_of(op)
             devs = self._devices_of(pc)
-            on_host = getattr(pc, "device_type", None) == DeviceType.CPU
+            on_host = host_sparse(op, pc)
             ft = self.cost.op_time(op, pc, "forward")
             bt = self.cost.op_time(op, pc, "backward")
             for j in range(pc.num_parts()):
@@ -168,10 +173,10 @@ class Simulator:
             if not op.weights:
                 continue
             pc = pc_of(op)
-            if getattr(pc, "device_type", None) == DeviceType.CPU:
-                # host-resident weights (row-sparse tables): the update
-                # is the host scatter-add already priced in the op's
-                # backward — no device-side grad allreduce exists
+            if host_sparse(op, pc):
+                # host-resident row-sparse table: the update is the host
+                # scatter-add already priced in the op's backward — no
+                # device-side grad allreduce exists
                 continue
             devs = self._devices_of(pc)
             for wi, w in enumerate(op.weights):
