@@ -397,8 +397,6 @@ class FFModel:
 
         seg_ins, boundaries = plan_boundaries(
             stages, tail, set(self._constants.keys()), self.input_tensors)
-        if not seg_ins:
-            raise ValueError("pipeline: segment consumes no graph input")
         final_out = stages[-1][-1].output
 
         import math
@@ -857,8 +855,20 @@ class FFModel:
         initializes the backend, and offline tools must never hang on a
         wedged TPU tunnel for a structure question — the runtime check
         in ``_sparse_embed_ok`` covers multi-process."""
-        return (isinstance(op, Embedding) and op.share_from is None
-                and any(op.inputs[0] is t for t in self.input_tensors))
+        if not (isinstance(op, Embedding) and op.share_from is None
+                and any(op.inputs[0] is t for t in self.input_tensors)):
+            return False
+        # Swap-in remaps the index input to the compact row space, so
+        # row-sparse execution additionally requires every consumer of
+        # that input to be an own-table Embedding.  This half of the
+        # runtime check is strategy-independent, so search candidates
+        # and report rows must apply it too — otherwise they price a
+        # batch-scaled host path for a plan the runtime would silently
+        # execute table-scaled.
+        idx_t = op.inputs[0]
+        return all(isinstance(o, Embedding) and o.share_from is None
+                   for o in self.ops
+                   if any(t is idx_t for t in o.inputs))
 
     def _sparse_embed_candidate_ok(self, op) -> bool:
         """Search-time eligibility: structural checks plus the optimizer

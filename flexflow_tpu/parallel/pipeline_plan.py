@@ -89,10 +89,24 @@ def plan_boundaries(stages: List[List], tail: Sequence, const_guids,
     boundaries: List[List] = []
     all_tensors = {t.guid: t for g in stages for op in g for t in op.outputs}
     all_tensors.update(seen_inputs)
+    if S > 0 and not seg_ins:
+        raise ValueError(
+            "pipeline: stage 0 consumes no graph input (constants only) "
+            "— the ring would have an empty feed bundle; merge the "
+            "degenerate stage into its successor")
     for si in range(S - 1):
         hop = [t for guid, t in sorted(all_tensors.items())
                if last_use.get(guid, -1) > si
                and (guid in seen_inputs or stage_of.get(guid, S) <= si)]
+        if not hop:
+            # the executor packs each hop with _bundle_pack, which has
+            # no representation for an empty payload — fail with the
+            # plan-level diagnosis instead of an IndexError deep in jit
+            raise ValueError(
+                f"pipeline: hop {si}->{si + 1} carries no tensors (later "
+                f"stages consume only constants) — degenerate partition; "
+                f"merge stage {si + 1} into stage {si} or use fewer "
+                f"stages")
         boundaries.append(hop)
 
     final_out = stages[-1][-1].output

@@ -260,6 +260,11 @@ class CostModel:
         the table."""
         m = self.machine
         rows = int(np.prod(op.inputs[0].dims))  # global batch x bag
+        # the runtime transfers at most u_max = min(num_entries,
+        # round8(n_idx)) unique rows (model.py swap-in) — without this
+        # cap, small tables under large batches are overpriced and the
+        # search is biased away from host placement
+        rows = min(rows, int(op.num_entries))
         vol = 4.0 * rows * op.out_dim           # f32 rows on the wire
         t = (vol / m.host_memory_bandwidth + vol / m.pcie_bandwidth
              + m.kernel_launch_overhead)

@@ -201,7 +201,10 @@ class Simulator:
                         # backends sync sparse grads).  Pricing the full
                         # table here would gift the searched strategy a
                         # fantasy speedup over a DP baseline no backend
-                        # executes that way.
+                        # executes that way.  Caveat (stated in report
+                        # provenance): THIS runtime's jitted DP step
+                        # all-reduces the dense table grad, so for it
+                        # the clamp is a lower bound on DP sync cost.
                         rows = int(np.prod(op.inputs[0].dims))
                         d_tile = (first_r[-1][1] - first_r[-1][0] + 1
                                   if first_r else 1)

@@ -89,10 +89,17 @@ def main(argv: Optional[List[str]] = None):
 
     # hetero host-embedding plan (reference dlrm_strategy_hetero.cc):
     # tables host-resident ROW-SPARSE, everything else data-parallel
+    # gate on the same eligibility predicate the runtime enforces —
+    # host-placing an ineligible table would price the row-sparse path
+    # for a plan that actually executes as full-table streaming
     het_rt = None
-    if any(op._type == "Embedding" for op in model.ops):
+    eligible = getattr(model, "_sparse_embed_candidate_ok",
+                       lambda _: False)
+    elig = {op.name for op in model.ops
+            if op._type == "Embedding" and eligible(op)}
+    if elig:
         het = {op.name: (ParallelConfig.host_rowsparse()
-                         if op._type == "Embedding" else dp[op.name])
+                         if op.name in elig else dp[op.name])
                for op in model.ops}
         het_rt = sim.simulate_runtime(model, het)
 
@@ -152,6 +159,16 @@ def main(argv: Optional[List[str]] = None):
         f"{'fitted' if fitted else 'unfitted analytic'} roofline.",
         f"Search engine: {engine}, budget {args.budget} "
         f"(reference: FFModel::optimize MCMC, model.cc:1056-1107).",
+    ]
+    if any(op._type == "Embedding" for op in model.ops):
+        lines += [
+            "Assumption: device-placed DP embedding grad sync is priced "
+            "rows-touched (a sparse-aware allreduce, as real DP "
+            "recommender backends ship); this runtime's jitted DP step "
+            "currently all-reduces the dense full-table gradient, so "
+            "the simulated DP baseline is a LOWER bound on its cost.",
+    ]
+    lines += [
         "",
         "| strategy | simulated step | speedup |",
         "|---|---|---|",
