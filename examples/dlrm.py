@@ -74,7 +74,9 @@ def main(argv=None):
         u = sum(info["u_max"] for info in model._host_embed.values())
         total = sum(emb_sizes)
         print(f"host-sparse embeddings: {len(model._host_embed)} tables "
-              f"({total:,} rows host-resident), <= {u} rows/step on the wire")
+              f"({total:,} rows host-resident), <= {u} rows/step on the "
+              f"wire worst-case (adaptive bucket sizes to the observed "
+              f"unique counts)")
 
     sparse, dense, labels = synthetic_batch(cfg.batch_size, emb_sizes, bag, mlp_bot[0])
     inputs = {t: a for t, a in zip(sparse_in, sparse)}

@@ -94,6 +94,11 @@ class FFModel:
         # {"weight", "input", "input_key", "u_max"}
         self._host_embed: Dict[str, Dict[str, Any]] = {}
         self._host_idx: Dict[str, np.ndarray] = {}  # host copies of index batches
+        # async scatter-back of host-table rows (one in-flight step):
+        # update() dispatches and returns; the worker forces the row
+        # arrays and writes them home; _he_join() is the read barrier
+        self._he_pool = None
+        self._he_pending = None
         self.label_tensor: Optional[Tensor] = None
         self.machine: Optional[Machine] = None
         self.optimizer = None
@@ -1005,10 +1010,14 @@ class FFModel:
         """Per-step row gather for host-resident embedding tables
         (reference: embedding.cc:18-77 — CPU tasks touch only the
         batch's rows).  For each registered table: unique the batch's
-        indices on host, gather those rows (padded to the static
-        ``u_max`` so the jit signature never changes), remap the index
-        batch to the compact row space, and gather the same rows of any
-        table-shaped optimizer slot.  The dense in-jit optimizer update
+        indices on host, gather those rows (padded to an ADAPTIVE
+        bucket: the smallest power-of-two holding the step's unique
+        count, kept as a monotone high-water mark ``u_hwm`` and capped
+        at the all-unique ``u_max`` — skewed key distributions, the
+        DLRM norm, never pay worst-case all-unique padding, and the
+        monotone ladder bounds jit retraces to the handful of distinct
+        bucket shapes), remap the index batch to the compact row space,
+        and gather the same rows of any table-shaped optimizer slot.  The dense in-jit optimizer update
         then IS the lazy per-touched-row update, and
         ``_host_embed_scatter_back`` writes the rows home in place."""
         rep = self.machine.replicated()
@@ -1016,24 +1025,45 @@ class FFModel:
         batch_in = dict(batch)
         if opt_in is not None:
             opt_in = _copy_state_tree(opt_in)
-        ctxs = []
+        # pass 1 — table-INDEPENDENT host work (unique, remap, bucket):
+        # runs while the previous step's async scatter-back is still in
+        # flight, hiding this host cost behind the device step
+        preps = []
         for opn, info in self._host_embed.items():
-            wn = info["weight"]
-            table = params_in[opn][wn]
             key = info["input_key"]
             idx = self._host_idx.get(key)
             if idx is None:
                 idx = np.asarray(jax.device_get(batch[key]))
             uniq, inv = np.unique(idx, return_inverse=True)
             n = int(uniq.size)
-            u_max = info["u_max"]
-            uniq_p = np.zeros((u_max,), np.int64)
-            uniq_p[:n] = uniq
-            params_in[opn][wn] = jax.device_put(
-                np.ascontiguousarray(table[uniq_p]), rep)
+            b = 8
+            while b < n:
+                b <<= 1
+            u = min(info["u_max"], max(b, info.get("u_hwm", 0)))
+            if opt_in is not None:
+                # training step: grow the monotone bucket and account
+                # wire traffic.  Eval/predict (opt_in None) still sizes
+                # THIS call's pad correctly but must not inflate the
+                # train bucket (extra retrace) or the per-train-step
+                # telemetry bench.py reports.
+                info["u_hwm"] = u
+                info["uniq_rows_total"] = info.get("uniq_rows_total", 0) + n
+                info["uniq_rows_steps"] = info.get("uniq_rows_steps", 0) + 1
             batch_in[key] = self._place_batch(
                 inv.reshape(idx.shape).astype(np.int32),
                 self._input_batch_degree(info["input"]))
+            preps.append((opn, info, uniq, n, u))
+        # read barrier: the previous step's rows must be home before the
+        # tables are gathered
+        self._he_join()
+        ctxs = []
+        for opn, info, uniq, n, u in preps:
+            wn = info["weight"]
+            table = params_in[opn][wn]
+            uniq_p = np.zeros((u,), np.int64)
+            uniq_p[:n] = uniq
+            params_in[opn][wn] = jax.device_put(
+                np.ascontiguousarray(table[uniq_p]), rep)
             slots = []
             if opt_in is not None:
                 for k, v in opt_in.items():
@@ -1050,23 +1080,56 @@ class FFModel:
         return params_in, opt_in, batch_in, ctxs
 
     def _host_embed_scatter_back(self, new_params, new_opt, ctxs):
-        """Write each table's updated rows (and optimizer-state rows)
-        back into the host arrays in place; the returned trees carry the
-        full host tables again."""
+        """Swap the host tables back into the returned trees and write
+        the step's updated rows home ASYNCHRONOUSLY.  The step's row
+        arrays are device futures, so forcing them (np.asarray) blocks
+        until the step completes; doing that on a worker thread lets
+        ``update()`` return at dispatch time, so the training loop's
+        host-side work for the next batch (data prep, set_batch, and
+        swap-in pass 1: unique/remap/bucket) overlaps the device step —
+        the overlap Legion's dataflow gives the reference's CPU
+        embedding tasks for free (embedding.cc:18-77).  ``_he_join()``
+        is the read barrier (swap-in pass 2, sync, weight accessors,
+        checkpoint)."""
+        step_params, step_opt = new_params, new_opt
         new_params = _copy_params_tree(new_params)
         if new_opt is not None:
             new_opt = _copy_state_tree(new_opt)
         for ctx in ctxs:
+            opn, wn = ctx["op"], ctx["weight"]
+            new_params[opn][wn] = ctx["table"]
+            for k, full in ctx["slots"]:
+                new_opt[k][opn][wn] = full
+        if self._he_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._he_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ff-host-embed")
+        self._he_join()  # at most one step in flight
+        self._he_pending = self._he_pool.submit(
+            self._he_write_rows, step_params, step_opt, ctxs)
+        return new_params, new_opt
+
+    @staticmethod
+    def _he_write_rows(step_params, step_opt, ctxs):
+        """Worker: force the updated row arrays and scatter them into
+        the host tables (and optimizer-state arrays) in place."""
+        for ctx in ctxs:
             opn, wn, n = ctx["op"], ctx["weight"], ctx["n"]
             uniq, table = ctx["uniq"], ctx["table"]
-            rows = np.asarray(new_params[opn][wn])
+            rows = np.asarray(step_params[opn][wn])
             table[uniq] = rows[:n].astype(table.dtype)
-            new_params[opn][wn] = table
             for k, full in ctx["slots"]:
-                srows = np.asarray(new_opt[k][opn][wn])
+                srows = np.asarray(step_opt[k][opn][wn])
                 full[uniq] = srows[:n].astype(full.dtype)
-                new_opt[k][opn][wn] = full
-        return new_params, new_opt
+
+    def _he_join(self):
+        """Read barrier for the async scatter-back: wait for the
+        in-flight row write (if any) and re-raise worker exceptions.
+        Must run before any host-table read or write."""
+        f = self._he_pending
+        if f is not None:
+            self._he_pending = None
+            f.result()
 
     def _offload_put(self, tree, to_host: bool):
         """Move host-offloaded weights between pinned-host and device
@@ -1616,6 +1679,9 @@ class FFModel:
         weights unpack to per-op entries (the decode runner walks ops
         sequentially, not the GPipe ring).  Cached until a train step or
         restore replaces ``_params``."""
+        # read barrier: decode reads host-resident tables the async
+        # scatter-back may still be writing
+        self._he_join()
         if self._pipe_pack() is None:
             return self._params
         cached = getattr(self, "_dp_cache", None)
@@ -1982,6 +2048,7 @@ class FFModel:
         small device→host transfer: a real synchronization barrier on
         every backend (block_until_ready alone does not block on some
         experimental PJRT platforms)."""
+        self._he_join()
         if self._metric_acc is not None:
             jax.device_get(self._metric_acc)
         elif self._params is not None:
@@ -2029,6 +2096,7 @@ class FFModel:
         return None
 
     def get_parameter(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        self._he_join()
         e = self._pack_entry(op_name, weight_name)
         if e is not None:
             # Slice the slot row on device first — fetching the whole
@@ -2037,9 +2105,16 @@ class FFModel:
             _, off, shape, n = e
             row = self._params["_pipe"]["buffer"][e[0], off:off + n]
             return np.asarray(row).reshape(shape)
-        return np.asarray(self._params[op_name][weight_name])
+        w = self._params[op_name][weight_name]
+        if isinstance(w, np.ndarray):
+            # host-resident table: np.asarray would alias the live
+            # array the scatter-back mutates in place — copy, matching
+            # the device leaves (device_get always materializes fresh)
+            return w.copy()
+        return np.asarray(w)
 
     def set_parameter(self, op_name: str, weight_name: str, value: np.ndarray) -> None:
+        self._he_join()
         e = self._pack_entry(op_name, weight_name)
         if e is not None:
             cur = self._params["_pipe"]["buffer"]

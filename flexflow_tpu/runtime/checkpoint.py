@@ -140,6 +140,8 @@ def _apply_tree(model, state: Dict[str, Any]) -> None:
 
 def save_checkpoint(model, path: str, force: bool = True) -> None:
     """Write the model's full training state to ``path`` (a directory)."""
+    # read barrier: an async host-table scatter-back may be in flight
+    getattr(model, "_he_join", lambda: None)()
     if path.endswith(".npz"):
         _save_npz(model, path)
         return
@@ -156,6 +158,8 @@ def save_checkpoint(model, path: str, force: bool = True) -> None:
 def load_checkpoint(model, path: str) -> None:
     """Restore training state saved by save_checkpoint, re-sharded onto
     the model's current mesh."""
+    # an in-flight scatter-back would race the restored tables
+    getattr(model, "_he_join", lambda: None)()
     if os.path.isfile(path) or path.endswith(".npz"):
         _load_npz(model, path)
         return

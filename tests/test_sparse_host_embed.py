@@ -80,7 +80,9 @@ def test_transfer_scales_with_batch_not_table(devices):
     assert u_large * 8 < 50_000  # far below table row count
     p_in, _, batch_in, ctxs = m_large._host_embed_swap_in(
         m_large._params, m_large._opt_state, m_large._batch)
-    assert p_in["emb"]["weight"].shape == (u_large, 8)
+    u_hwm = m_large._host_embed["emb"]["u_hwm"]
+    assert u_hwm <= u_large  # adaptive bucket never exceeds the cap
+    assert p_in["emb"]["weight"].shape == (u_hwm, 8)
     m_large.train_iteration()
     m_large.sync()
 
@@ -138,6 +140,76 @@ def test_sparse_checkpoint_roundtrip(tmp_path, devices):
     assert isinstance(m2._params["emb"]["weight"], np.ndarray)
     m2.train_iteration()
     m2.sync()
+
+
+def test_adaptive_bucket_with_repeated_keys(devices):
+    """Skewed key distributions (few unique ids — the DLRM norm) pay a
+    small power-of-two bucket on the wire, not the all-unique worst
+    case; the bucket grows monotonically to its high-water mark and
+    never shrinks (no retrace thrash)."""
+    cfg = ff.FFConfig(batch_size=16)
+    cfg.strategies["emb"] = ff.ParallelConfig(DeviceType.CPU, (1, 1), (0,))
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((16, 4), dtype="int32", name="ids")
+    t = m.embedding(ids, 1000, 8, name="emb")
+    t = m.dense(t, 4, name="head")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(m, lr=0.1),
+              ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [ff.MetricsType.ACCURACY])
+    m.init_layers(seed=3)
+    info = m._host_embed["emb"]
+    y = np.zeros((16, 1), np.int32)
+    x_skew = (np.arange(64).reshape(16, 4) % 5).astype(np.int32)  # 5 ids
+    m.set_batch({ids: x_skew}, y)
+    p_in, _, _, _ = m._host_embed_swap_in(m._params, m._opt_state, m._batch)
+    assert info["u_max"] == 64          # all-unique worst case
+    assert info["u_hwm"] == 8           # bucket for 5 uniques
+    assert p_in["emb"]["weight"].shape == (8, 8)
+    m.train_iteration()
+    m.sync()
+    # a more-unique batch grows the bucket...
+    x_full = np.arange(64).reshape(16, 4).astype(np.int32)
+    m.set_batch({ids: x_full}, y)
+    m.train_iteration()
+    m.sync()
+    assert info["u_hwm"] == 64
+    # ...and a skewed batch afterwards does NOT shrink it back
+    m.set_batch({ids: x_skew}, y)
+    m.train_iteration()
+    m.sync()
+    assert info["u_hwm"] == 64
+    # actual unique counts are accounted for reporting
+    assert info["uniq_rows_steps"] >= 3
+    assert info["uniq_rows_total"] >= 5 + 64 + 5
+
+
+def test_async_scatter_back_overlaps(devices):
+    """update() returns at dispatch with the scatter-back in flight on
+    the worker thread; every table read joins first, so results are
+    identical to the synchronous path."""
+    m = _build(offload=True)
+    m.train_iteration()
+    # the finisher was submitted (the future stays until a join point)
+    assert m._he_pending is not None
+    # accessor is a read barrier: joins, then sees the written rows
+    w1 = m.get_parameter("emb", "weight")
+    assert m._he_pending is None
+    # next iteration resubmits; sync() is also a read barrier
+    m.train_iteration()
+    assert m._he_pending is not None
+    m.sync()
+    assert m._he_pending is None
+    w2 = m.get_parameter("emb", "weight")
+    assert np.abs(w2 - w1).max() > 0  # training progressed
+    # worker exceptions surface at the join point, not silently
+    from concurrent.futures import Future
+    f = Future()
+    f.set_exception(RuntimeError("boom"))
+    m._he_pending = f
+    with pytest.raises(RuntimeError, match="boom"):
+        m.sync()
+    assert m._he_pending is None
 
 
 def test_eval_uses_sparse_gather(devices):
