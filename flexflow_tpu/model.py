@@ -333,7 +333,8 @@ class FFModel:
                      stages: Optional[Sequence[Sequence[str]]] = None,
                      num_microbatches: int = 4,
                      degree: Optional[int] = None,
-                     dp_degree: int = 1) -> None:
+                     dp_degree: int = 1,
+                     remat: Optional[bool] = None) -> None:
         """Assign the op graph to pipeline stages (operator placement).
 
         The reference pipelines heterogeneous graphs by pinning each op to
@@ -348,7 +349,11 @@ class FFModel:
         graph), or ``num_stages`` to auto-balance the chain by per-op
         FLOPs.  ``degree``: ring size (defaults to num_stages; must divide
         it).  ``dp_degree``: batch-parallel degree composed with the
-        pipeline (dp x pp).  Call before ``compile()``.
+        pipeline (dp x pp).  ``remat``: rematerialize each ring slot so
+        only boundary carries are stashed across the scan — the memory
+        lever that lets ``num_microbatches`` grow and shrink the GPipe
+        bubble fraction (defaults to ``config.remat``; see
+        docs/ADR-002-pipeline-schedule.md).  Call before ``compile()``.
         """
         if stages is None:
             assert num_stages is not None and num_stages >= 1
@@ -357,7 +362,8 @@ class FFModel:
             self._pipeline_req = {"num_stages": len(stages),
                                   "names": [list(g) for g in stages]}
         self._pipeline_req.update(num_microbatches=int(num_microbatches),
-                                  degree=degree, dp_degree=int(dp_degree))
+                                  degree=degree, dp_degree=int(dp_degree),
+                                  remat=remat)
 
     def _plan_pipeline(self) -> None:
         """Resolve ``set_pipeline`` into a validated stage plan.
@@ -431,6 +437,8 @@ class FFModel:
             "stages": stages, "degree": int(degree),
             "dp_degree": int(req["dp_degree"]),
             "num_microbatches": int(req["num_microbatches"]),
+            "remat": bool(self.config.remat if req.get("remat") is None
+                          else req["remat"]),
             "seg_ins": seg_ins, "boundaries": boundaries,
             "seg_in_guids": {t.guid for t in seg_ins},
             "seg_out": final_out,
@@ -663,7 +671,8 @@ class FFModel:
         y = pipeline_graph_apply(fns, seg_params, x, self.machine.mesh,
                                  pipe_axes, mb, in_shapes, out_shapes,
                                  batch_axes=batch_axes,
-                                 param_specs=param_specs)
+                                 param_specs=param_specs,
+                                 remat=plan.get("remat", False))
         out_l, _ = self._bundle_layout([seg_out], pdtype)
         return self._bundle_unpack(y.reshape(x.shape[0], -1),
                                    out_l, pdtype)[seg_out.guid]
@@ -776,13 +785,15 @@ class FFModel:
                     print(f"flexflow_tpu: search selected a pipeline plan "
                           f"({plan['num_stages']} stages x "
                           f"dp{plan['dp_degree']}, "
-                          f"M={plan['num_microbatches']}): "
+                          f"M={plan['num_microbatches']}"
+                          f"{', remat' if plan.get('remat') else ''}): "
                           f"{plan['simulated_s'] * 1e3:.3f} ms vs "
                           f"{dims_t * 1e3:.3f} ms for the dim strategy")
                     self.set_pipeline(
                         num_stages=plan["num_stages"],
                         dp_degree=plan["dp_degree"],
-                        num_microbatches=plan["num_microbatches"])
+                        num_microbatches=plan["num_microbatches"],
+                        remat=plan.get("remat"))
 
         # Per-op partition configs (default: data parallel over all devices,
         # reference model.cc:391-401 + strategy.cc:28-85 fallback).

@@ -178,7 +178,7 @@ def gpipe_hetero_spmd(stage_fns: Sequence[Callable], params, x_local,
                       axis_name, ring_size: int, num_microbatches: int,
                       in_shapes: Sequence[Tuple[int, ...]],
                       out_shapes: Sequence[Tuple[int, ...]],
-                      dtype) -> jax.Array:
+                      dtype, remat: bool = False) -> jax.Array:
     """GPipe schedule for per-stage heterogeneous functions.
 
     Runs inside shard_map over the pipe axis.  ``stage_fns[s]`` maps a
@@ -197,10 +197,20 @@ def gpipe_hetero_spmd(stage_fns: Sequence[Callable], params, x_local,
     s = lax.axis_index(axis_name)
 
     def make_branch(i):
-        def branch(h, micro_idx):
-            y = stage_fns[i](params, _unflat(h, in_shapes[i], dtype),
-                             micro_idx)
+        def raw(p, h, micro_idx):
+            y = stage_fns[i](p, _unflat(h, in_shapes[i], dtype), micro_idx)
             return _flat_pad(y, pad, dtype)
+        if remat:
+            # Rematerialized ring: grad-of-scan keeps only the boundary
+            # carries as residuals and recomputes each stage's interior
+            # in backward — the memory lever that lets M grow and shrink
+            # the fill/drain bubble fraction (P-1)/(M+P-1).  See
+            # docs/ADR-002-pipeline-schedule.md for why this dominates a
+            # literal 1F1B schedule under XLA's lockstep scan semantics.
+            raw = jax.checkpoint(raw)
+
+        def branch(h, micro_idx):
+            return raw(params, h, micro_idx)
         return branch
 
     branches = [make_branch(i) for i in range(P)]
@@ -258,7 +268,7 @@ def pipeline_graph_apply(stage_fns: Sequence[Callable], params, x,
                          in_shapes: Sequence[Tuple[int, ...]],
                          out_shapes: Sequence[Tuple[int, ...]],
                          batch_axes: Optional[Union[str, Sequence[str]]] = None,
-                         param_specs=None):
+                         param_specs=None, remat: bool = False):
     """Pipeline a chain of heterogeneous stage functions over ``pipe_axes``.
 
     ``stage_fns[s](params, h, micro_idx)`` consumes/produces per-sample
@@ -320,7 +330,8 @@ def pipeline_graph_apply(stage_fns: Sequence[Callable], params, x,
              out_specs=x_spec, check_vma=False)
     def run(pl, xl):
         y = gpipe_hetero_spmd(ring_fns, pl, xl, axis_name, ring,
-                              num_microbatches, ring_in, ring_out, dtype)
+                              num_microbatches, ring_in, ring_out, dtype,
+                              remat=remat)
         return _replica_correct(y, mesh, extra)
 
     out_flat = run(params, xf)

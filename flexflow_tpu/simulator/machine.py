@@ -41,6 +41,7 @@ class TPUMachineModel:
     # ZCM placement): chip<->host PCIe and host DDR stream bandwidth.
     pcie_bandwidth: float = 32e9      # bytes/s per direction (gen4 x16)
     host_memory_bandwidth: float = 100e9  # bytes/s effective DDR gather
+    hbm_capacity: float = 16e9        # bytes per chip (v5e 16 GB)
 
     @classmethod
     def calibrated(cls, **kw) -> "TPUMachineModel":

@@ -9,17 +9,30 @@ per-op SOAP dims, and this module extends it over the OTHER axis:
 contiguous stage assignments executed by ``FFModel.set_pipeline``.
 
 Cost model for a dp×pp plan with S ring slots and M microbatches
-(GPipe, parallel/pipeline.py semantics):
+(GPipe under grad-of-scan, parallel/pipeline.py semantics — see
+docs/ADR-002-pipeline-schedule.md for why this schedule, not a literal
+1F1B, is the right lockstep-XLA form and how remat + large M delivers
+1F1B's bubble-shrinking intent):
 
-    t_slot   = per-microbatch fwd+bwd time of the slowest slot
+    t_f/t_b  = per-microbatch fwd / bwd time of the slowest slot
                (per-op costs from the measured/calibrated CostModel at
                the dp-sharded, microbatched sub-shape)
     t_comm   = boundary buffer ppermute per tick (padded to the largest
-               flattened boundary — exactly what the runtime ships)
-    t_pipe   = (M + S - 1) · (t_slot + t_comm)   + weight-sync allreduce
+               flattened boundary — exactly what the runtime ships);
+               paid in BOTH scans (the bwd scan transposes the ring)
+    t_pipe   = (M + S - 1) · (t_f + t_b [+ t_f if remat] + 2·t_comm)
+               + weight-sync allreduce
 
-The searcher sweeps the (S, dp, M) grid (S·dp = devices), costs each
-plan, and returns the best alongside the pure dim-search baseline so
+    mem      = weights·(1 + opt-state factor) + activation residuals:
+               non-remat stashes each tick's slot interiors,
+               remat stashes only the boundary carries and pays the
+               recompute forward in t_pipe — the trade that lets M grow
+               and the bubble fraction (S-1)/(M+S-1) shrink.  Plans over
+               the HBM budget are rejected.
+
+The searcher sweeps the (S, dp) grid (S·dp = devices) × every divisor
+M of the local batch × {remat, no remat}, costs each plan, and returns
+the best alongside the pure dim-search baseline so
 ``suggest_parallelization`` can answer: data-parallel, SOAP dims, or
 pipeline?
 """
@@ -52,12 +65,17 @@ def _pipeline_segment(model):
 
 
 def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
-                       S: int, dp: int, microbatches: int) -> Optional[dict]:
+                       S: int, dp: int, microbatches: int,
+                       remat: Optional[bool] = None) -> Optional[dict]:
     """{"t": simulated seconds/iteration, "m": the ADJUSTED microbatch
-    count the plan actually uses} for a dp×S GPipe plan, or None when
-    the plan is not executable (branching dataflow the ring cannot
-    carry, or shapes/batch that don't divide) — validated with the SAME
-    rules FFModel._plan_pipeline enforces."""
+    count the plan actually uses, "mem": estimated per-device bytes,
+    "remat": schedule} for a dp×S GPipe plan, or None when the plan is
+    not executable (branching dataflow the ring cannot carry,
+    shapes/batch that don't divide — validated with the SAME rules
+    FFModel._plan_pipeline enforces) or over the HBM budget.  With
+    ``remat=None`` both schedules are derived from ONE costing pass
+    (remat only changes two arithmetic terms) and the cheaper in-budget
+    one is returned."""
     from ..parallel.pipeline_plan import balanced_stages, plan_boundaries
 
     pair = _pipeline_segment(model)
@@ -85,17 +103,24 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
 
     # per-slot per-microbatch compute: cost the op at batch degree
     # batch/mb (so the sub-shape's leading dim is the microbatch size)
-    slot_t = []
+    slot_f, slot_b, slot_act = [], [], []
     for g in stages:
-        t = 0.0
+        tf = tb = 0.0
+        act = 0
         for op in g:
             deg0 = max(1, op.output.dims[0] // mb)
             pc = ParallelConfig(dims=(deg0,) + (1,) * (op.output.num_dims - 1))
             pc = op.legalize_pc(pc)
-            t += cost.op_time(op, pc, "forward")
-            t += cost.op_time(op, pc, "backward")
-        slot_t.append(t)
-    t_slot = max(slot_t)
+            tf += cost.op_time(op, pc, "forward")
+            tb += cost.op_time(op, pc, "backward")
+            # per-microbatch interior activations this slot stashes as
+            # scan residuals when NOT remat'd
+            act += int(np.prod(op.output.dims)) // max(1, op.output.dims[0]) \
+                * mb
+        slot_f.append(tf)
+        slot_b.append(tb)
+        slot_act.append(act)
+    t_f, t_b = max(slot_f), max(slot_b)
 
     # boundary ring: buffers pad to the largest flattened bundle —
     # stage-0's input bundle, each hop's k packed tensors, the final
@@ -114,45 +139,77 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     pad = max(bounds)
     t_comm = machine.transfer_time(0, 1, cost._dtype_bytes * mb * pad)
 
-    t_pipe = (M + S - 1) * (t_slot + t_comm)
-
     # weight sync: dp-replica grad allreduce of each slot's weights
     # (stage weights live only on their slot — model._plan_pipeline_pack)
-    if dp > 1:
-        w_elems = max(
-            sum(w.volume() for op in g for w in op.weights) for g in stages)
-        t_pipe += machine.allreduce_time(list(range(dp)), 4.0 * w_elems)
-    return {"t": t_pipe, "m": M}
+    w_elems = max(
+        sum(w.volume() for op in g for w in op.weights) for g in stages)
+    t_sync = (machine.allreduce_time(list(range(dp)), 4.0 * w_elems)
+              if dp > 1 else 0.0)
+
+    ticks = M + S - 1
+    carry_bytes = cost._dtype_bytes * mb * pad
+    best = None
+    for rm in ((False, True) if remat is None else (remat,)):
+        # both scans pay the ring; remat's bwd tick recomputes the fwd
+        t_pipe = ticks * (t_f + t_b + 2.0 * t_comm
+                          + (t_f if rm else 0.0)) + t_sync
+        # HBM budget: weights (f32 master + grad + optimizer slot) plus
+        # scan residuals alive at the fwd->bwd turnaround — every
+        # tick's stash (interiors drop out under remat)
+        if rm:
+            act = ticks * carry_bytes + max(slot_act) * cost._dtype_bytes
+        else:
+            act = ticks * (max(slot_act) * cost._dtype_bytes + carry_bytes)
+        mem = 3.0 * 4.0 * w_elems + act
+        if mem > 0.9 * machine.hbm_capacity:
+            continue
+        if best is None or t_pipe < best["t"]:
+            best = {"t": t_pipe, "m": M, "mem": mem, "remat": rm}
+    return best
 
 
 def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
-                    microbatches: int = 4,
+                    microbatches: Optional[int] = None,
                     compute_dtype: Optional[str] = None) -> Optional[Dict]:
-    """Best (S, dp, M) pipeline plan over the machine, or None when no
-    executable plan exists.  Returns {"num_stages", "dp_degree",
-    "num_microbatches", "simulated_s"}."""
+    """Best (S, dp, M, remat) pipeline plan over the machine, or None
+    when no executable plan exists.  Returns {"num_stages", "dp_degree",
+    "num_microbatches", "remat", "simulated_s", "mem_bytes"}.  By
+    default M sweeps EVERY divisor of the local batch (remat makes the
+    large-M, small-bubble corner of the grid memory-feasible); passing
+    ``microbatches`` restricts the sweep to {M, 2M} for callers that
+    want the legacy behavior."""
     nd = model.machine.num_devices if model.machine is not None \
         else model.config.num_devices
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
     dtype = compute_dtype or model.config.compute_dtype
     cost = CostModel(mm, measure=False, compute_dtype=dtype)
+    batch = model.ops[0].output.dims[0] if model.ops else 0
     best = None
     for S in [d for d in range(2, nd + 1) if nd % d == 0]:
         dp = nd // S
-        for M in {microbatches, 2 * microbatches}:
+        if batch <= 0 or batch % dp != 0:
+            continue
+        local_b = batch // dp
+        if microbatches is None:
+            Ms = [m for m in range(1, local_b + 1) if local_b % m == 0]
+        else:
+            Ms = sorted({microbatches, 2 * microbatches})
+        for M in Ms:
             r = cost_pipeline_plan(model, mm, cost, S, dp, M)
             if r is not None and (best is None
                                   or r["t"] < best["simulated_s"]):
-                # report the ADJUSTED microbatch count the costing used —
-                # the requested one may not divide the local batch
+                # report the ADJUSTED microbatch count the costing
+                # used — the requested one may not divide the batch
                 best = {"num_stages": S, "dp_degree": dp,
-                        "num_microbatches": r["m"], "simulated_s": r["t"]}
+                        "num_microbatches": r["m"], "remat": r["remat"],
+                        "simulated_s": r["t"], "mem_bytes": r["mem"]}
     return best
 
 
 def suggest_parallelization(model, budget: int = 2000,
                             machine_model: Optional[TPUMachineModel] = None,
-                            seed: int = 0, microbatches: int = 4) -> Dict:
+                            seed: int = 0,
+                            microbatches: Optional[int] = None) -> Dict:
     """Search BOTH spaces — per-op SOAP dims and pipeline stage
     assignment — and return the faster plan:
 

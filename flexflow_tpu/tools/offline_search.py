@@ -141,12 +141,15 @@ def main(argv: Optional[List[str]] = None):
         if plan is not None:
             mark = "<-- beats the dim search" \
                 if plan["simulated_s"] < best_rt else ""
+            rm = plan.get("remat", False)
             print(f"pipeline plan: {plan['num_stages']} stages x "
-                  f"dp{plan['dp_degree']}, M={plan['num_microbatches']}: "
+                  f"dp{plan['dp_degree']}, M={plan['num_microbatches']}"
+                  f"{', remat' if rm else ''}: "
                   f"{plan['simulated_s'] * 1e3:.3f} ms/iter {mark}\n"
                   f"  (apply via FFModel.set_pipeline(num_stages="
                   f"{plan['num_stages']}, dp_degree={plan['dp_degree']}, "
-                  f"num_microbatches={plan['num_microbatches']}))")
+                  f"num_microbatches={plan['num_microbatches']}, "
+                  f"remat={rm}))")
 
     if args.export:
         save_strategies_to_file(args.export, best)

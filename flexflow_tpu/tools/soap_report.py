@@ -179,7 +179,8 @@ def main(argv: Optional[List[str]] = None):
     if pipe_plan is not None:
         lines.append(
             f"| pipeline plan ({pipe_plan['num_stages']} stages x "
-            f"dp{pipe_plan['dp_degree']}, M={pipe_plan['num_microbatches']}) "
+            f"dp{pipe_plan['dp_degree']}, M={pipe_plan['num_microbatches']}"
+            f"{', remat' if pipe_plan.get('remat') else ''}) "
             f"| {pipe_plan['simulated_s'] * 1e3:.3f} ms | "
             f"{dp_rt / pipe_plan['simulated_s']:.2f}x |")
     else:

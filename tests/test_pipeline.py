@@ -150,6 +150,19 @@ def test_general_pipeline_heterogeneous_mlp(devices):
     np.testing.assert_allclose(b_ref, b_pp, rtol=2e-4, atol=2e-5)
 
 
+def test_general_pipeline_remat_numerics(devices):
+    """Rematerialized ring (boundary-only residuals, the schedule
+    ADR-002 picks over literal 1F1B) == plain ring == sequential, and
+    large M runs: the bubble-shrinking corner the search can now
+    reach."""
+    a_ref, b_ref, _ = _train_general(None)
+    a_rm, b_rm, m = _train_general(
+        dict(num_stages=4, num_microbatches=8, remat=True))
+    assert m._pipeline_plan["remat"] is True
+    np.testing.assert_allclose(a_ref, a_rm, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(b_ref, b_rm, rtol=2e-4, atol=2e-5)
+
+
 @pytest.mark.slow
 def test_general_pipeline_dp_x_pp(devices):
     """dp=2 x pp=4 over the 8-device mesh, microbatches per dp shard."""

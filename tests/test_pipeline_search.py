@@ -47,6 +47,51 @@ def test_search_pipeline_returns_executable_plan(devices):
     assert m2._pipeline_plan is not None
 
 
+def test_search_sweeps_m_and_prices_remat(devices):
+    """The default sweep covers every divisor-M of the local batch and
+    both schedules; larger M shrinks the bubble fraction, and the remat
+    variant pays a recompute forward but stashes only boundary carries
+    (ADR-002)."""
+    m = _mlp()
+    mm = TPUMachineModel(num_devices=8)
+    from flexflow_tpu.simulator.cost_model import CostModel
+
+    cost = CostModel(mm, measure=False)
+    r_small = cost_pipeline_plan(m, mm, cost, S=4, dp=2, microbatches=2)
+    r_big = cost_pipeline_plan(m, mm, cost, S=4, dp=2, microbatches=16)
+    assert r_small and r_big
+    # bigger M amortizes the fill/drain bubble per sample
+    assert r_big["t"] / 16 < r_small["t"] / 2
+    r_rm = cost_pipeline_plan(m, mm, cost, S=4, dp=2, microbatches=16,
+                              remat=True)
+    assert r_rm is not None
+    assert r_rm["t"] > r_big["t"]      # recompute forward is priced
+    assert r_rm["mem"] < r_big["mem"]  # boundary-only residuals
+    plan = search_pipeline(m, machine_model=mm)
+    assert plan is not None and "remat" in plan and plan["mem_bytes"] > 0
+    # the sweep reached past the legacy {4, 8} grid
+    assert plan["num_microbatches"] in range(1, 17)
+
+
+def test_search_rejects_over_memory_plans(devices):
+    """A machine with a tiny HBM forces the search toward remat or
+    rejects the plan outright — memory is part of the objective."""
+    m = _mlp()
+    from flexflow_tpu.simulator.cost_model import CostModel
+
+    mm_small = TPUMachineModel(num_devices=8, hbm_capacity=1.2e5)
+    cost = CostModel(mm_small, measure=False)
+    r = cost_pipeline_plan(m, mm_small, cost, S=4, dp=2, microbatches=16,
+                           remat=False)
+    # non-remat residuals blow the 120 KB budget; remat still fits, and
+    # the default best-of-both costing therefore lands on remat
+    assert r is None
+    r_any = cost_pipeline_plan(m, mm_small, cost, S=4, dp=2,
+                               microbatches=16)
+    assert r_any is not None and r_any["remat"] is True
+    assert r_any["mem"] <= 0.9 * 1.2e5
+
+
 def test_pipeline_cost_scales_with_stages(devices):
     """More slots shrink per-slot compute; the bubble term (M+S-1) and
     comm keep the curve honest — cost must be finite and positive, and
