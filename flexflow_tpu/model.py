@@ -908,8 +908,10 @@ class FFModel:
 
     def _sparse_embed_ok(self, op) -> bool:
         """Row-sparse host placement applies when the op is an Embedding
-        with its own table fed straight from a graph input, in a single
-        process, under a built-in SGD/Adam optimizer.  Auto mode
+        with its own table fed straight from a graph input, under a
+        built-in SGD/Adam optimizer.  Multi-process runs shard the table
+        by row range across hosts (reference: run_summit.sh multi-node
+        CPU-embedding DLRM) — see ``_host_embed_swap_in``.  Auto mode
         (``config.sparse_host_embeddings is None``) additionally requires
         the update rule to be identity on untouched rows (plain SGD) so
         sparse and dense training are bit-identical; forcing the flag
@@ -918,7 +920,6 @@ class FFModel:
         from .optimizers import AdamOptimizer, SGDOptimizer
 
         if not (self._sparse_embed_structural_ok(op)
-                and jax.process_count() == 1
                 and isinstance(self.optimizer, (SGDOptimizer, AdamOptimizer))):
             return False
         # Swap-in REMAPS the index input's batch values to the compact
@@ -979,12 +980,22 @@ class FFModel:
                     # (replicated: they're batch-sized).
                     idx_t = op.inputs[0]
                     n_idx = int(np.prod(idx_t.dims))
+                    # multi-process: each host OWNS a contiguous row
+                    # range of the table (reference: run_summit.sh
+                    # places per-node CPU embedding shards)
+                    P = jax.process_count()
+                    N = int(op.num_entries)
+                    per = -(-N // P)
+                    lo = min(N, jax.process_index() * per)
+                    hi = min(N, lo + per)
                     self._host_embed[op.name] = {
                         "weight": w.name,
                         "input": idx_t,
                         "input_key": f"in_{idx_t.guid}",
                         "u_max": int(min(op.num_entries,
                                          -(-n_idx // 8) * 8)),
+                        "row_lo": lo, "row_hi": hi, "rows_per": per,
+                        "num_entries": N,
                     }
                     specs[w.name] = NamedSharding(self.machine.mesh,
                                                   PartitionSpec())
@@ -1039,13 +1050,27 @@ class FFModel:
         # pass 1 — table-INDEPENDENT host work (unique, remap, bucket):
         # runs while the previous step's async scatter-back is still in
         # flight, hiding this host cost behind the device step
+        nproc = jax.process_count()
         preps = []
         for opn, info in self._host_embed.items():
             key = info["input_key"]
             idx = self._host_idx.get(key)
             if idx is None:
                 idx = np.asarray(jax.device_get(batch[key]))
-            uniq, inv = np.unique(idx, return_inverse=True)
+            if nproc > 1:
+                # the compact row space must be GLOBAL (grads for the
+                # gathered buffer psum across processes): union every
+                # host's local uniques via a fixed-size id allgather
+                from jax.experimental import multihost_utils
+                local = np.unique(idx)
+                pad_ids = np.full((info["u_max"],), -1, np.int64)
+                pad_ids[:local.size] = local
+                all_ids = np.asarray(
+                    multihost_utils.process_allgather(pad_ids))
+                uniq = np.unique(all_ids[all_ids >= 0])
+                inv = np.searchsorted(uniq, idx)
+            else:
+                uniq, inv = np.unique(idx, return_inverse=True)
             n = int(uniq.size)
             b = 8
             while b < n:
@@ -1073,8 +1098,26 @@ class FFModel:
             table = params_in[opn][wn]
             uniq_p = np.zeros((u,), np.int64)
             uniq_p[:n] = uniq
-            params_in[opn][wn] = jax.device_put(
-                np.ascontiguousarray(table[uniq_p]), rep)
+
+            def gather(shard):
+                """(u, D) buffer of the compact rows.  Multi-process:
+                each host fills the rows IT owns and an allgather-sum
+                assembles the full buffer (every compact id has exactly
+                one owner, so the sum is exact) — the per-host gather +
+                DCN exchange of the reference's multi-node CPU
+                embeddings (run_summit.sh)."""
+                if nproc == 1:
+                    return np.ascontiguousarray(shard[uniq_p])
+                from jax.experimental import multihost_utils
+                lo, hi = info["row_lo"], info["row_hi"]
+                part = np.zeros((u,) + shard.shape[1:], shard.dtype)
+                own = (uniq_p >= lo) & (uniq_p < hi)
+                part[own] = shard[uniq_p[own] - lo]
+                return np.ascontiguousarray(np.asarray(
+                    multihost_utils.process_allgather(part))
+                    .sum(0, dtype=shard.dtype))
+
+            params_in[opn][wn] = jax.device_put(gather(table), rep)
             slots = []
             if opt_in is not None:
                 for k, v in opt_in.items():
@@ -1083,11 +1126,13 @@ class FFModel:
                     if full is not None and \
                             getattr(full, "shape", None) == table.shape:
                         v[opn][wn] = jax.device_put(
-                            np.ascontiguousarray(np.asarray(full)[uniq_p]),
-                            rep)
+                            gather(np.asarray(full)), rep)
                         slots.append((k, full))
             ctxs.append({"op": opn, "weight": wn, "table": table,
-                         "uniq": uniq, "n": n, "slots": slots})
+                         "uniq": uniq, "n": n, "slots": slots,
+                         "row_lo": info["row_lo"],
+                         "row_hi": info["row_hi"],
+                         "multi": nproc > 1})
         return params_in, opt_in, batch_in, ctxs
 
     def _host_embed_scatter_back(self, new_params, new_opt, ctxs):
@@ -1123,15 +1168,23 @@ class FFModel:
     @staticmethod
     def _he_write_rows(step_params, step_opt, ctxs):
         """Worker: force the updated row arrays and scatter them into
-        the host tables (and optimizer-state arrays) in place."""
+        the host tables (and optimizer-state arrays) in place.  In a
+        multi-process run each host writes ONLY the rows it owns — the
+        updated buffer is replicated, so no communication is needed and
+        the lazy-row update stays local."""
         for ctx in ctxs:
             opn, wn, n = ctx["op"], ctx["weight"], ctx["n"]
             uniq, table = ctx["uniq"], ctx["table"]
+            if ctx.get("multi"):
+                sel = (uniq >= ctx["row_lo"]) & (uniq < ctx["row_hi"])
+                dst = uniq[sel] - ctx["row_lo"]
+            else:
+                sel, dst = slice(None), uniq
             rows = np.asarray(step_params[opn][wn])
-            table[uniq] = rows[:n].astype(table.dtype)
+            table[dst] = rows[:n][sel].astype(table.dtype)
             for k, full in ctx["slots"]:
                 srows = np.asarray(step_opt[k][opn][wn])
-                full[uniq] = srows[:n].astype(full.dtype)
+                full[dst] = srows[:n][sel].astype(full.dtype)
 
     def _he_join(self):
         """Read barrier for the async scatter-back: wait for the
@@ -1141,6 +1194,27 @@ class FFModel:
         if f is not None:
             self._he_pending = None
             f.result()
+
+    def _he_info(self, op_name: str, weight_name: str):
+        """Row-range sharding info when ``(op, weight)`` is a host table
+        sharded across processes, else None."""
+        info = self._host_embed.get(op_name)
+        if (info and info["weight"] == weight_name
+                and jax.process_count() > 1):
+            return info
+        return None
+
+    @staticmethod
+    def _he_assemble_full(info, shard: np.ndarray) -> np.ndarray:
+        """Assemble the FULL table from this host's row-range shard via
+        a process allgather (shards pad to the common per-host size)."""
+        from jax.experimental import multihost_utils
+        per = info["rows_per"]
+        pad = np.zeros((per,) + shard.shape[1:], shard.dtype)
+        pad[:shard.shape[0]] = shard
+        allp = np.asarray(multihost_utils.process_allgather(pad))
+        return np.ascontiguousarray(
+            allp.reshape((-1,) + shard.shape[1:])[:info["num_entries"]])
 
     def _offload_put(self, tree, to_host: bool):
         """Move host-offloaded weights between pinned-host and device
@@ -1220,6 +1294,10 @@ class FFModel:
                 hkey = jax.device_put(key, cpu0)
                 v = np.array(w.initializer(jax.random.fold_in(hkey, salt),
                                            w.dims, jnp.float32))
+            if jax.process_count() > 1:
+                # every host computes the same full init (one threefry
+                # stream) and keeps only its OWNED row range
+                v = v[info["row_lo"]:info["row_hi"]].copy()
             self._params.setdefault(opn, {})[w.name] = v
         self._stats = {}
         for op in self.ops:
@@ -1433,6 +1511,20 @@ class FFModel:
             return plan["dp_degree"]
         for op in self.ops:
             if t in op.inputs:
+                if op.name in self._host_embed:
+                    # host-placed row-sparse embedding: its pc is the
+                    # host sentinel (degree 1 = replicated), but a
+                    # replicated batch leaf cannot be assembled from
+                    # per-host local shards in a multi-process run —
+                    # shard the indices with the table OUTPUT's consumer
+                    # dp degree instead (the lookup into the replicated
+                    # gathered-row buffer distributes over batch)
+                    out = op.output
+                    for o2 in self.ops:
+                        if out in o2.inputs \
+                                and o2.name not in self._host_embed:
+                            return o2.pc.dims[0]
+                    return max(1, jax.process_count())
                 return op.pc.dims[0]
         return 1
 
@@ -2107,6 +2199,13 @@ class FFModel:
         return None
 
     def get_parameter(self, op_name: str, weight_name: str = "kernel") -> np.ndarray:
+        """Fetch a weight as numpy (reference: Parameter::get_weights).
+
+        Multi-process NOTE: for a row-range-sharded host-resident
+        embedding table this assembles the FULL table via a process
+        allgather — a COLLECTIVE, so every process must call it in the
+        same order (a rank-0-only call deadlocks, like any collective).
+        """
         self._he_join()
         e = self._pack_entry(op_name, weight_name)
         if e is not None:
@@ -2118,6 +2217,11 @@ class FFModel:
             return np.asarray(row).reshape(shape)
         w = self._params[op_name][weight_name]
         if isinstance(w, np.ndarray):
+            info = self._he_info(op_name, weight_name)
+            if info is not None:
+                # row-range-sharded across processes: return the FULL
+                # table (single-process accessor semantics)
+                return self._he_assemble_full(info, w)
             # host-resident table: np.asarray would alias the live
             # array the scatter-back mutates in place — copy, matching
             # the device leaves (device_get always materializes fresh)
@@ -2135,6 +2239,9 @@ class FFModel:
             return
         cur = self._params[op_name][weight_name]
         if isinstance(cur, np.ndarray):  # row-sparse host-resident table
+            info = self._he_info(op_name, weight_name)
+            if info is not None:  # full table in, own row range kept
+                value = np.asarray(value)[info["row_lo"]:info["row_hi"]]
             self._params[op_name][weight_name] = np.asarray(
                 value, dtype=cur.dtype).reshape(cur.shape).copy()
             return

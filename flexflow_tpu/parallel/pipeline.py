@@ -207,7 +207,10 @@ def gpipe_hetero_spmd(stage_fns: Sequence[Callable], params, x_local,
             # the fill/drain bubble fraction (P-1)/(M+P-1).  See
             # docs/ADR-002-pipeline-schedule.md for why this dominates a
             # literal 1F1B schedule under XLA's lockstep scan semantics.
-            raw = jax.checkpoint(raw)
+            # prevent_cse=False: the scan's loop structure already rules
+            # out the CSE remat guards against, and the default barriers
+            # would block fusion inside the (M+P-1)-tick hot loop
+            raw = jax.checkpoint(raw, prevent_cse=False)
 
         def branch(h, micro_idx):
             return raw(params, h, micro_idx)

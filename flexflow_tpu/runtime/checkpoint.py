@@ -29,30 +29,52 @@ import numpy as np
 
 def _unpack_tree(model, tree: Dict[str, Any]) -> Dict[str, Any]:
     """Canonicalize a params-shaped tree: expand a pipelined model's
-    packed ``_pipe`` stage-weight buffer into per-op arrays so
-    checkpoints are layout-portable (pipeline <-> plain, different stage
-    splits, different meshes)."""
+    packed ``_pipe`` stage-weight buffer into per-op arrays, and
+    assemble row-range-sharded host-resident embedding tables (and
+    their table-shaped optimizer state) into FULL arrays — so
+    checkpoints are layout-portable (pipeline <-> plain, different
+    stage splits, meshes, or process counts)."""
     pack = model._pipe_pack() if hasattr(model, "_pipe_pack") else None
-    if not pack or "_pipe" not in tree:
-        return tree
-    buf = tree["_pipe"]["buffer"]  # device-side: multi-host shards stay put
-    rows = {}  # slice each ring row once, not once per weight
-    out = {k: v for k, v in tree.items() if k != "_pipe"}
-    for opn, ws in pack["entries"].items():
-        d = dict(out.get(opn, {}))
-        for wn, e in ws.items():
-            row = rows.get(e[0])
-            if row is None:
-                row = rows[e[0]] = buf[e[0]]
-            d[wn] = model._pack_read(row, e)
-        out[opn] = d
-    return out
+    if pack and "_pipe" in tree:
+        buf = tree["_pipe"]["buffer"]  # device: multi-host shards stay put
+        rows = {}  # slice each ring row once, not once per weight
+        out = {k: v for k, v in tree.items() if k != "_pipe"}
+        for opn, ws in pack["entries"].items():
+            d = dict(out.get(opn, {}))
+            for wn, e in ws.items():
+                row = rows.get(e[0])
+                if row is None:
+                    row = rows[e[0]] = buf[e[0]]
+                d[wn] = model._pack_read(row, e)
+            out[opn] = d
+        tree = out
+    for opn, info in getattr(model, "_host_embed", {}).items():
+        wn = info["weight"]
+        shard = tree.get(opn, {}).get(wn)
+        if (model._he_info(opn, wn) is not None
+                and isinstance(shard, np.ndarray)
+                and shard.shape[0] == info["row_hi"] - info["row_lo"]):
+            tree = {k: (dict(v) if k == opn else v) for k, v in tree.items()}
+            tree[opn][wn] = model._he_assemble_full(info, shard)
+    return tree
 
 
 def _repack_tree(model, canonical: Dict[str, Any], like: Dict[str, Any]) -> Dict[str, Any]:
     """Inverse of _unpack_tree: fold per-op arrays of packed ops back
     into the model's ``_pipe`` buffer, placed with the LIKE leaf's
-    sharding (params vs ZeRO-sharded optimizer slots differ)."""
+    sharding (params vs ZeRO-sharded optimizer slots differ), and slice
+    canonical FULL host-embedding tables back to this process's owned
+    row range."""
+    for opn, info in getattr(model, "_host_embed", {}).items():
+        wn = info["weight"]
+        full = canonical.get(opn, {}).get(wn) \
+            if isinstance(canonical, dict) else None
+        if (model._he_info(opn, wn) is not None and full is not None
+                and np.asarray(full).shape[0] == info["num_entries"]):
+            canonical = {k: (dict(v) if k == opn else v)
+                         for k, v in canonical.items()}
+            canonical[opn][wn] = np.ascontiguousarray(
+                np.asarray(full)[info["row_lo"]:info["row_hi"]])
     pack = model._pipe_pack() if hasattr(model, "_pipe_pack") else None
     if not pack or not isinstance(like, dict) or "_pipe" not in like:
         return canonical

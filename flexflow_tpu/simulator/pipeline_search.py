@@ -64,9 +64,31 @@ def _pipeline_segment(model):
     return seg, tail
 
 
+def _stage_prep(model, S: int):
+    """M-independent planning for an S-slot ring: the stage split,
+    dataflow boundaries, and pad width — hoisted so the divisor-M sweep
+    doesn't redo it once per M.  None when no executable partition."""
+    from ..parallel.pipeline_plan import balanced_stages, plan_boundaries
+
+    pair = _pipeline_segment(model)
+    if pair is None or S < 2:
+        return None
+    seg, tail = pair
+    stages = balanced_stages(seg, S)
+    if len(stages) != S:
+        return None
+    try:
+        seg_ins, boundaries = plan_boundaries(
+            stages, tail, set(model._constants.keys()), model.input_tensors)
+    except ValueError:
+        return None  # non-topological partition
+    return stages, seg_ins, boundaries
+
+
 def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
                        S: int, dp: int, microbatches: int,
-                       remat: Optional[bool] = None) -> Optional[dict]:
+                       remat: Optional[bool] = None,
+                       prep=None) -> Optional[dict]:
     """{"t": simulated seconds/iteration, "m": the ADJUSTED microbatch
     count the plan actually uses, "mem": estimated per-device bytes,
     "remat": schedule} for a dp×S GPipe plan, or None when the plan is
@@ -75,13 +97,8 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     FFModel._plan_pipeline enforces) or over the HBM budget.  With
     ``remat=None`` both schedules are derived from ONE costing pass
     (remat only changes two arithmetic terms) and the cheaper in-budget
-    one is returned."""
-    from ..parallel.pipeline_plan import balanced_stages, plan_boundaries
-
-    pair = _pipeline_segment(model)
-    if pair is None or S < 2:
-        return None
-    seg, tail = pair
+    one is returned.  ``prep``: a ``_stage_prep(model, S)`` result to
+    reuse across an M sweep."""
     batch = model.ops[0].output.dims[0]
     if batch % dp != 0:
         return None
@@ -92,14 +109,11 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     mb = local_b // M
     if mb < 1:
         return None
-    stages = balanced_stages(seg, S)
-    if len(stages) != S:
+    if prep is None:
+        prep = _stage_prep(model, S)
+    if prep is None:
         return None
-    try:
-        seg_ins, boundaries = plan_boundaries(
-            stages, tail, set(model._constants.keys()), model.input_tensors)
-    except ValueError:
-        return None  # non-topological partition
+    stages, seg_ins, boundaries = prep
 
     # per-slot per-microbatch compute: cost the op at batch degree
     # batch/mb (so the sub-shape's leading dim is the microbatch size)
@@ -194,8 +208,11 @@ def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
             Ms = [m for m in range(1, local_b + 1) if local_b % m == 0]
         else:
             Ms = sorted({microbatches, 2 * microbatches})
+        prep = _stage_prep(model, S)
+        if prep is None:
+            continue
         for M in Ms:
-            r = cost_pipeline_plan(model, mm, cost, S, dp, M)
+            r = cost_pipeline_plan(model, mm, cost, S, dp, M, prep=prep)
             if r is not None and (best is None
                                   or r["t"] < best["simulated_s"]):
                 # report the ADJUSTED microbatch count the costing

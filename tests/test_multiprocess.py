@@ -221,3 +221,91 @@ def test_two_process_pipeline_training(devices):
     ref = (float(np.sum(np.abs(k1))), float(np.sum(k1 * k1)),
            float(np.sum(np.abs(k3))))
     np.testing.assert_allclose(fprints[0], ref, rtol=1e-4)
+
+
+_CHILD_SPARSE = """
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+sys.path.insert(0, {root!r})
+import flexflow_tpu as ff
+from flexflow_tpu.config import DeviceType
+from flexflow_tpu.parallel import distributed as dist
+
+dist.initialize()
+pid = jax.process_index()
+assert jax.process_count() == 2
+
+cfg = ff.FFConfig(batch_size=16, workers_per_node=4, num_nodes=2)
+cfg.strategies['emb'] = ff.ParallelConfig(DeviceType.CPU, (1, 1), (0,))
+m = ff.FFModel(cfg)
+ids = m.create_tensor((16, 4), dtype='int32', name='ids')
+t = m.embedding(ids, 1000, 8, name='emb')
+t = m.dense(t, 4, name='head')
+m.softmax(t, name='sm')
+m.compile(ff.SGDOptimizer(m, lr=0.1),
+          ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+          [ff.MetricsType.ACCURACY])
+m.init_layers(seed=11)
+assert 'emb' in m._host_embed, 'row-sparse path not taken multi-process'
+info = m._host_embed['emb']
+assert (info['row_lo'], info['row_hi']) == (pid * 500, (pid + 1) * 500)
+assert m._params['emb']['weight'].shape[0] == 500  # own shard only
+
+rng = np.random.default_rng(0)
+X = rng.integers(0, 1000, (16, 4)).astype(np.int32)   # the GLOBAL batch
+Y = (X[:, 0] % 4).astype(np.int32)[:, None]
+half = 8
+lo, hi = pid * half, (pid + 1) * half
+for _ in range(6):
+    m.set_batch({{ids: X[lo:hi]}}, Y[lo:hi])   # host-LOCAL shard
+    m.train_iteration()
+m.sync()
+w = m.get_parameter('emb', 'weight')   # accessor assembles the FULL table
+h = m.get_parameter('head', 'kernel')
+assert w.shape[0] == 1000, w.shape
+print('FPRINT', pid, float(np.sum(np.abs(w))), float(np.sum(w * w)),
+      float(np.sum(np.abs(h))), flush=True)
+dist.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_two_process_row_sparse_host_embeddings(devices):
+    """REAL 2-process row-sparse host embeddings: each host owns a row
+    range of the table (reference run_summit.sh multi-node CPU-embedding
+    DLRM), the compact row space is global, grads psum across hosts, and
+    each host lazily updates only its owned rows.  Both controllers'
+    ASSEMBLED tables agree AND match a single-process run on the same
+    global batch."""
+    fprints, _ = _run_two_controllers(_CHILD_SPARSE)
+    np.testing.assert_allclose(fprints[0], fprints[1], rtol=1e-5)
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.config import DeviceType
+
+    cfg = ff.FFConfig(batch_size=16, workers_per_node=8)
+    cfg.strategies["emb"] = ff.ParallelConfig(DeviceType.CPU, (1, 1), (0,))
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((16, 4), dtype="int32", name="ids")
+    t = m.embedding(ids, 1000, 8, name="emb")
+    t = m.dense(t, 4, name="head")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(m, lr=0.1),
+              ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [ff.MetricsType.ACCURACY])
+    m.init_layers(seed=11)
+    assert "emb" in m._host_embed
+    rng = np.random.default_rng(0)
+    X = rng.integers(0, 1000, (16, 4)).astype(np.int32)
+    Y = (X[:, 0] % 4).astype(np.int32)[:, None]
+    for _ in range(6):
+        m.set_batch({ids: X}, Y)
+        m.train_iteration()
+    m.sync()
+    w = m.get_parameter("emb", "weight")
+    h = m.get_parameter("head", "kernel")
+    ref = (float(np.sum(np.abs(w))), float(np.sum(w * w)),
+           float(np.sum(np.abs(h))))
+    np.testing.assert_allclose(fprints[0], ref, rtol=1e-4)
