@@ -41,7 +41,7 @@ from .parallel.strategy import load_strategies_from_file, save_strategies_to_fil
 from .runtime.dataloader import DataLoader
 from .tensor import DataType, Parameter, Tensor
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
 __all__ = [
     "ActiMode", "AdamOptimizer", "AggrMode", "ConstantInitializer",
