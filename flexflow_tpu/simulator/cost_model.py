@@ -267,7 +267,7 @@ class CostModel:
         rows = min(rows, int(op.num_entries))
         vol = 4.0 * rows * op.out_dim           # f32 rows on the wire
         t = (vol / m.host_memory_bandwidth + vol / m.pcie_bandwidth
-             + m.kernel_launch_overhead)
+             + m.kernel_launch_overhead + m.host_xfer_latency)
         if which == "backward":
             # row grads back over PCIe + host scatter-add + state row update
             t *= 2.0

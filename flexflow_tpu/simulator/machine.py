@@ -41,6 +41,10 @@ class TPUMachineModel:
     # ZCM placement): chip<->host PCIe and host DDR stream bandwidth.
     pcie_bandwidth: float = 32e9      # bytes/s per direction (gen4 x16)
     host_memory_bandwidth: float = 100e9  # bytes/s effective DDR gather
+    # Fixed per-transfer host<->device latency (0 on local PCIe; tens of
+    # ms behind a network tunnel — tools/calibrate.py fits it from the
+    # measured host_xfer ladder alongside pcie_bandwidth).
+    host_xfer_latency: float = 0.0
     hbm_capacity: float = 16e9        # bytes per chip (v5e 16 GB)
 
     @classmethod

@@ -66,6 +66,81 @@ def candidate_jobs(model, nd: int, cost, full: bool) -> List[Tuple]:
     return jobs
 
 
+def _beat(heartbeat_path: Optional[str], key, i) -> None:
+    if not heartbeat_path:
+        return
+    try:
+        # atomic replace: the supervisor polls concurrently and a torn
+        # read must never masquerade as a wedged worker
+        tmp = heartbeat_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"key": key, "i": i, "t": time.time()}, f)
+        os.replace(tmp, heartbeat_path)
+    except OSError:
+        pass
+
+
+def measure_host_transfer(cost, verbose: bool = True,
+                          heartbeat_path: Optional[str] = None,
+                          skip_keys: Optional[set] = None) -> int:
+    """Measure the effective host<->device transfer rate over a size
+    ladder — the constant the host-resident-embedding cost path prices
+    as ``pcie_bandwidth``.  On this deployment the chip sits behind a
+    network tunnel, so the MEASURED number (not the PCIe spec sheet) is
+    the honest input; per-direction time = round-trip / 2, and the
+    ladder's slope/intercept separate bandwidth from per-transfer
+    latency (fit_host_transfer)."""
+    import jax
+    import numpy as np
+
+    skip_keys = skip_keys or set()
+    done = 0
+    for nbytes in (1 << 20, 8 << 20, 64 << 20):
+        key = f"host_xfer:{nbytes}"
+        if key in cost._measured or key in skip_keys:
+            continue
+        _beat(heartbeat_path, key, -1)
+        arr = np.ones((nbytes // 4,), np.float32)
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            d = jax.device_put(arr)
+            np.asarray(jax.device_get(d))  # forces both directions
+            ts.append(time.perf_counter() - t0)
+        t = float(np.median(ts)) / 2.0  # seconds per direction
+        cost._measured[key] = t
+        cost._persist(key, t)
+        done += 1
+        if verbose:
+            print(f"[calibrate] {key} -> {t * 1e3:.2f} ms/direction "
+                  f"({nbytes / t / 1e9:.2f} GB/s)", flush=True)
+    # null done-sentinel: the worker's final beat must never be a real
+    # key, or a slow backend teardown reads as that job having hung and
+    # the supervisor kills/excludes/restarts for nothing
+    _beat(heartbeat_path, None, -1)
+    return done
+
+
+def fit_host_transfer(cost) -> dict:
+    """Least-squares t = latency + bytes/bw over the host_xfer ladder;
+    returns machine-model overrides ({} when unmeasured)."""
+    import numpy as np
+
+    pts = sorted((int(k.split(":")[1]), t)
+                 for k, t in cost._measured.items()
+                 if k.startswith("host_xfer:"))
+    if len(pts) < 2:
+        return {}
+    x = np.array([p[0] for p in pts], float)
+    y = np.array([p[1] for p in pts], float)
+    A = np.vstack([np.ones_like(x), x]).T
+    (lat, slope), *_ = np.linalg.lstsq(A, y, rcond=None)
+    if slope <= 0:
+        return {}
+    return {"pcie_bandwidth": float(1.0 / slope),
+            "host_xfer_latency": float(max(0.0, lat))}
+
+
 def run_measurements(jobs, cost, max_seconds: float, verbose: bool,
                      heartbeat_path: Optional[str] = None,
                      skip_keys: Optional[set] = None) -> int:
@@ -83,16 +158,7 @@ def run_measurements(jobs, cost, max_seconds: float, verbose: bool,
     skip_keys = skip_keys or set()
 
     def beat(key, i):
-        if heartbeat_path:
-            try:
-                # atomic replace: the supervisor polls concurrently and a
-                # torn read must never masquerade as a wedged worker
-                tmp = heartbeat_path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump({"key": key, "i": i, "t": time.time()}, f)
-                os.replace(tmp, heartbeat_path)
-            except OSError:
-                pass
+        _beat(heartbeat_path, key, i)
 
     for i, (op, pc, which, key) in enumerate(jobs):
         if time.time() - t_start > max_seconds:
@@ -417,6 +483,9 @@ def main(argv: Optional[List[str]] = None):
         run_measurements(jobs, cost, args.max_seconds,
                          verbose=not args.quiet,
                          heartbeat_path=args.heartbeat, skip_keys=skip)
+        measure_host_transfer(cost, verbose=not args.quiet,
+                              heartbeat_path=args.heartbeat,
+                              skip_keys=skip)
         if args.worker:
             # fit happens in the supervising parent, from the cache
             print(f"[calibrate] worker done: {len(cost._measured)} "
@@ -425,6 +494,9 @@ def main(argv: Optional[List[str]] = None):
 
     recs = collect_fit_records(models, nds, cost)
     fit = fit_machine(recs, mm)
+    hx = fit_host_transfer(cost)
+    if fit and hx:
+        fit.update(hx)  # measured tunnel/PCIe rate for the host tier
     if fit and platform != "tpu" and not args.fit_only and args.fit_out is None:
         # Never let a CPU-host dry run overwrite the packaged TPU fit —
         # TPUMachineModel.calibrated() has no platform filter of its own.
@@ -434,12 +506,15 @@ def main(argv: Optional[List[str]] = None):
     if fit:
         with open(fit_out, "w") as f:
             json.dump(fit, f, indent=1)
+        pcie = (f" pcie={fit['pcie_bandwidth'] / 1e9:.1f}GB/s"
+                if "pcie_bandwidth" in fit else "")
         print(f"[calibrate] fitted over {fit['fit_points']} points "
               f"(log-rmse {fit['fit_log_rmse']:.3f}): "
               f"mxu_eff={fit['mxu_efficiency']:.2f} "
               f"hbm={fit['hbm_bandwidth'] / 1e9:.0f}GB/s "
               f"ovh={fit['kernel_launch_overhead'] * 1e6:.0f}us "
-              f"bwd_mult={fit['backward_multiplier']:.2f} -> {fit_out}")
+              f"bwd_mult={fit['backward_multiplier']:.2f}{pcie} "
+              f"-> {fit_out}")
     print(f"[calibrate] measured cache: {len(cost._measured)} entries -> {out}")
 
 

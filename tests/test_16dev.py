@@ -35,6 +35,7 @@ def test_dryrun_multichip_16():
         "ge.dryrun_multichip(16)\n")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "dryrun_multichip(16): pipeline ok" in r.stdout
+    assert "remat ring" in r.stdout           # round-5 boundary-only ring
     assert "dlrm host-sparse ok" in r.stdout  # round-4 fifth graph
 
 

@@ -95,3 +95,39 @@ def test_doctor_cli(devices):
     from flexflow_tpu.tools.doctor import main
 
     assert main(["--skip-accelerator"]) == 0
+
+
+def test_calibrate_host_transfer_measure_and_fit(tmp_path, devices):
+    """The host<->device transfer ladder measures on any backend and the
+    least-squares fit recovers bandwidth + latency — the measured input
+    for the host-embedding cost path's pcie_bandwidth."""
+    from flexflow_tpu.simulator.cost_model import CostModel
+    from flexflow_tpu.simulator.machine import TPUMachineModel
+    from flexflow_tpu.tools.calibrate import (fit_host_transfer,
+                                              measure_host_transfer)
+
+    # synthetic ladder: 25 GB/s + 2 ms latency must be recovered exactly
+    cost = CostModel(TPUMachineModel(num_devices=1), cache_path="")
+    for nbytes in (1 << 20, 8 << 20, 64 << 20):
+        cost._measured[f"host_xfer:{nbytes}"] = 2e-3 + nbytes / 25e9
+    fit = fit_host_transfer(cost)
+    assert abs(fit["pcie_bandwidth"] - 25e9) / 25e9 < 1e-6
+    assert abs(fit["host_xfer_latency"] - 2e-3) < 1e-9
+
+    # a real measurement pass lands positive entries and persists them
+    cache = str(tmp_path / "cache.json")
+    cost2 = CostModel(TPUMachineModel(num_devices=1), cache_path=cache,
+                      target_platform="cpu")
+    n = measure_host_transfer(cost2, verbose=False)
+    assert n == 3
+    assert all(cost2._measured[f"host_xfer:{b}"] > 0
+               for b in (1 << 20, 8 << 20, 64 << 20))
+    fit2 = fit_host_transfer(cost2)
+    assert not fit2 or fit2["pcie_bandwidth"] > 0
+
+    # persisted with platform provenance (a CPU dry run must never pose
+    # as a TPU measurement)
+    import json as _json
+    with open(cache) as f:
+        data = _json.load(f)
+    assert data["host_xfer:1048576"]["platform"] == "cpu"

@@ -41,7 +41,15 @@ while :; do
     touch /tmp/TPU_ALIVE
     bash tools/chip_session.sh 2>&1 | tee /tmp/chip_session.log
     echo "tpu_watch: chip_session finished rc=$? at $(date -u +%FT%TZ)"
-    exit 0
+    # a wedge mid-window can leave the fit or the bench number unlanded
+    # (every chip_session stage is resumable from its durable cache) —
+    # keep watching and convert the next window instead of giving up
+    if [ -f flexflow_tpu/simulator/machine_v5e.json ] \
+        && grep -q '"value": [1-9]' /tmp/bench_line.json 2>/dev/null; then
+      echo "tpu_watch: window fully converted"
+      exit 0
+    fi
+    echo "tpu_watch: window converted PARTIALLY; re-arming the probe loop"
   fi
   echo "tpu_watch: probe #$n no answer at $(date -u +%FT%TZ); retry in ${INTERVAL}s"
   sleep "$INTERVAL"
