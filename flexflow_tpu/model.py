@@ -89,6 +89,7 @@ class FFModel:
         self._constants: Dict[int, Any] = {}  # guid -> (Tensor, fill value)
         self._offload: Dict[Tuple[str, str], Any] = {}  # host-offloaded weights
         self._offload_warned = False
+        self._pipe_host_drop_warned = False
         # Row-sparse host-resident embedding tables (reference:
         # embedding.cc CPU tasks touch only the batch's rows): op name ->
         # {"weight", "input", "input_key", "u_max"}
@@ -381,18 +382,50 @@ class FFModel:
         tail: List[Op] = []
         while seg and isinstance(seg[-1], Softmax):
             tail.insert(0, seg.pop())
+        # Host-placed row-sparse embeddings run BEFORE the ring as a
+        # heterogeneous head (the reference's hetero DLRM: CPU-resident
+        # tables + accelerator pipeline, dlrm_strategy_hetero.cc) —
+        # packing a host table into the device pipe buffer would
+        # silently drop the CPU placement.  Eligible embeddings depend
+        # only on graph inputs, so hoisting is always legal; their
+        # outputs feed stage 0 like extra segment inputs.
+        head: List[Op] = []
+        kept: List[Op] = []
+        for op in seg:
+            # the STRICT runtime predicate: hoisting an op the runtime
+            # would not actually execute row-sparse (e.g. a shared index
+            # consumed by a device-placed sibling) would exclude it from
+            # the ring for no benefit and stream its full table
+            if (isinstance(op, Embedding) and op.pc.host_placed
+                    and self._sparse_embed_ok(op)):
+                head.append(op)
+            else:
+                kept.append(op)
+        seg = kept
         if not seg:
             raise ValueError("pipeline: no ops to pipeline")
+        for op in seg:
+            if op.pc.host_placed and not self._pipe_host_drop_warned:
+                self._pipe_host_drop_warned = True
+                print(f"flexflow_tpu: host placement for {op.name} is "
+                      f"DROPPED inside the pipeline segment (stage "
+                      f"weights pack into the device ring buffer); only "
+                      f"row-sparse-eligible embeddings run host-side "
+                      f"ahead of the ring")
+        head_names = {op.name for op in head}
         if req["names"] is not None:
             by_name = {op.name: op for op in seg}
             stages = []
             for group in req["names"]:
-                stages.append([by_name[n] for n in group])
+                g = [by_name[n] for n in group if n not in head_names]
+                if g:
+                    stages.append(g)
             flat = [op for g in stages for op in g]
             if flat != seg:
                 raise ValueError(
                     "pipeline stages must be a contiguous in-order "
-                    "partition of the op graph (minus a trailing Softmax)")
+                    "partition of the op graph (minus a trailing Softmax "
+                    "and host-placed row-sparse embeddings)")
         else:
             from .parallel.pipeline_plan import balanced_stages
 
@@ -407,7 +440,8 @@ class FFModel:
         from .parallel.pipeline_plan import plan_boundaries
 
         seg_ins, boundaries = plan_boundaries(
-            stages, tail, set(self._constants.keys()), self.input_tensors)
+            stages, tail, set(self._constants.keys()),
+            list(self.input_tensors) + [op.output for op in head])
         final_out = stages[-1][-1].output
 
         import math
@@ -434,7 +468,7 @@ class FFModel:
                       f"; running without pipelining")
             return
         self._pipeline_plan = {
-            "stages": stages, "degree": int(degree),
+            "stages": stages, "head": head, "degree": int(degree),
             "dp_degree": int(req["dp_degree"]),
             "num_microbatches": int(req["num_microbatches"]),
             "remat": bool(self.config.remat if req.get("remat") is None
@@ -1470,9 +1504,26 @@ class FFModel:
                      stats_out={} if training else None)
         plan = getattr(self, "_pipeline_plan", None)
         use_pipe = (plan is not None and multi and plan["degree"] > 1)
+        head_ids = ({id(op) for op in plan["head"]}
+                    if use_pipe and plan.get("head") else set())
         i = 0
         while i < len(self.ops):
             if use_pipe and i == plan["i0"]:
+                # Heterogeneous head first: host-placed row-sparse
+                # embeddings may sit anywhere in op order (DLRM builds
+                # its bottom MLP before the tables) but their gathered
+                # rows must be in env before the ring packs stage 0's
+                # input bundle.
+                for hop in plan["head"]:
+                    if hop.output.guid not in env:
+                        hxs = [env[t.guid] for t in hop.inputs]
+                        hys = hop.forward(params.get(hop.param_key, {}),
+                                          hxs, ctx)
+                        if multi:
+                            hys = [self.machine.constraint(
+                                y, hop.constraint_pc()) for y in hys]
+                        for t, y in zip(hop.outputs, hys):
+                            env[t.guid] = y
                 # Pipelined segment: GPipe microbatch schedule over the
                 # pipe mesh axes (parallel/pipeline.py), replacing the
                 # sequential op walk for ops[i0:i1].
@@ -1481,6 +1532,9 @@ class FFModel:
                 i = plan["i1"]
                 continue
             op = self.ops[i]
+            if id(op) in head_ids and op.output.guid in env:
+                i += 1  # head op already ran at segment entry
+                continue
             xs = [env[t.guid] for t in op.inputs]
             pvals = params.get(op.param_key, {})
             if training and self.config.remat and op.weights \

@@ -50,18 +50,32 @@ from .machine import TPUMachineModel
 
 
 def _pipeline_segment(model):
-    """(segment ops, tail ops) set_pipeline would use, or None when the
-    chain has unsupported structure."""
+    """(segment ops, tail ops, head ops) matching FFModel._plan_pipeline:
+    trailing Softmax stays outside, host-placed row-sparse embeddings
+    run host-side AHEAD of the ring (hetero head — their outputs feed
+    stage 0 like extra inputs; their cost rides the parallel host
+    timeline, priced by the dim search's host tier, not the ring).
+    None when the chain has unsupported structure."""
     seg = list(model.ops)
     tail = []
     while seg and seg[-1]._type == "Softmax":
         tail.insert(0, seg.pop())
+    # the STRICT runtime predicate (matching _plan_pipeline): pricing a
+    # hoisted head the runtime would stream table-scaled would bias the
+    # search toward a plan that executes much slower
+    eligible = getattr(model, "_sparse_embed_ok", lambda _: False)
+    head = [op for op in seg
+            if op._type == "Embedding"
+            and getattr(getattr(op, "pc", None), "host_placed", False)
+            and eligible(op)]
+    head_ids = {id(op) for op in head}
+    seg = [op for op in seg if id(op) not in head_ids]
     if len(seg) < 2:
         return None
     for op in seg:
         if op.init_stats():
             return None  # running stats unsupported in the ring
-    return seg, tail
+    return seg, tail, head
 
 
 def _stage_prep(model, S: int):
@@ -73,13 +87,14 @@ def _stage_prep(model, S: int):
     pair = _pipeline_segment(model)
     if pair is None or S < 2:
         return None
-    seg, tail = pair
+    seg, tail, head = pair
     stages = balanced_stages(seg, S)
     if len(stages) != S:
         return None
     try:
         seg_ins, boundaries = plan_boundaries(
-            stages, tail, set(model._constants.keys()), model.input_tensors)
+            stages, tail, set(model._constants.keys()),
+            list(model.input_tensors) + [op.output for op in head])
     except ValueError:
         return None  # non-topological partition
     return stages, seg_ins, boundaries

@@ -494,27 +494,44 @@ def main(argv: Optional[List[str]] = None):
 
     recs = collect_fit_records(models, nds, cost)
     fit = fit_machine(recs, mm)
+    # the host-transfer ladder fits independently of the roofline —
+    # a window that wedged during op jobs but finished the ladder still
+    # lands the measured tunnel/PCIe rate
     hx = fit_host_transfer(cost)
-    if fit and hx:
-        fit.update(hx)  # measured tunnel/PCIe rate for the host tier
-    if fit and platform != "tpu" and not args.fit_only and args.fit_out is None:
+    merged = {**fit, **hx}
+    if merged and platform != "tpu" and not args.fit_only \
+            and args.fit_out is None:
         # Never let a CPU-host dry run overwrite the packaged TPU fit —
         # TPUMachineModel.calibrated() has no platform filter of its own.
         print(f"[calibrate] NOT writing machine fit: measured on "
               f"{platform!r}; pass --fit-out explicitly to keep it")
-        fit = {}
-    if fit:
+        merged, fit, hx = {}, {}, {}
+    if merged:
+        # merge over any existing fit so a ladder-only window never
+        # erases an earlier full roofline fit (and vice versa)
+        prev = {}
+        if os.path.exists(fit_out):
+            try:
+                with open(fit_out) as f:
+                    prev = json.load(f)
+            except Exception:
+                prev = {}
+        merged = {**prev, **merged}
         with open(fit_out, "w") as f:
-            json.dump(fit, f, indent=1)
-        pcie = (f" pcie={fit['pcie_bandwidth'] / 1e9:.1f}GB/s"
-                if "pcie_bandwidth" in fit else "")
-        print(f"[calibrate] fitted over {fit['fit_points']} points "
-              f"(log-rmse {fit['fit_log_rmse']:.3f}): "
-              f"mxu_eff={fit['mxu_efficiency']:.2f} "
-              f"hbm={fit['hbm_bandwidth'] / 1e9:.0f}GB/s "
-              f"ovh={fit['kernel_launch_overhead'] * 1e6:.0f}us "
-              f"bwd_mult={fit['backward_multiplier']:.2f}{pcie} "
-              f"-> {fit_out}")
+            json.dump(merged, f, indent=1)
+        pcie = (f" pcie={merged['pcie_bandwidth'] / 1e9:.1f}GB/s"
+                if "pcie_bandwidth" in merged else "")
+        if fit:
+            print(f"[calibrate] fitted over {fit['fit_points']} points "
+                  f"(log-rmse {fit['fit_log_rmse']:.3f}): "
+                  f"mxu_eff={fit['mxu_efficiency']:.2f} "
+                  f"hbm={fit['hbm_bandwidth'] / 1e9:.0f}GB/s "
+                  f"ovh={fit['kernel_launch_overhead'] * 1e6:.0f}us "
+                  f"bwd_mult={fit['backward_multiplier']:.2f}{pcie} "
+                  f"-> {fit_out}")
+        else:
+            print(f"[calibrate] roofline unfitted (no op records); "
+                  f"host-transfer fit landed:{pcie} -> {fit_out}")
     print(f"[calibrate] measured cache: {len(cost._measured)} entries -> {out}")
 
 

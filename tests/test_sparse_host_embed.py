@@ -212,6 +212,52 @@ def test_async_scatter_back_overlaps(devices):
     assert m._he_pending is None
 
 
+def test_host_table_composes_with_pipeline(devices):
+    """Hetero pipeline (reference dlrm_strategy_hetero.cc: CPU tables +
+    accelerator pipeline): a host-placed row-sparse embedding is lifted
+    OUT of the ring as a head op — table stays host-resident numpy, its
+    output feeds stage 0 like an extra input — and numerics match the
+    fully device-pipelined run."""
+    def run(host):
+        cfg = ff.FFConfig(batch_size=16, workers_per_node=8)
+        if host:
+            cfg.strategies["emb"] = ff.ParallelConfig(
+                DeviceType.CPU, (1, 1), (0,))
+        m = ff.FFModel(cfg)
+        ids = m.create_tensor((16, 4), dtype="int32", name="ids")
+        t = m.embedding(ids, 1000, 8, name="emb")
+        t = m.dense(t, 24, activation="relu", name="fc1")
+        t = m.dense(t, 24, activation="relu", name="fc2")
+        t = m.dense(t, 4, name="head")
+        m.softmax(t, name="sm")
+        m.set_pipeline(num_stages=2, num_microbatches=4)
+        m.compile(ff.SGDOptimizer(m, lr=0.1),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+        m.init_layers(seed=3)
+        x = np.random.default_rng(0).integers(0, 1000, (16, 4)) \
+            .astype(np.int32)
+        y = (x[:, 0] % 4).astype(np.int32)[:, None]
+        for _ in range(4):
+            m.set_batch({ids: x}, y)
+            m.train_iteration()
+        m.sync()
+        return m
+
+    m_host = run(True)
+    assert m_host._pipeline_plan is not None
+    assert [o.name for o in m_host._pipeline_plan["head"]] == ["emb"]
+    assert "emb" in m_host._host_embed  # NOT packed into the ring
+    assert isinstance(m_host._params["emb"]["weight"], np.ndarray)
+    m_dev = run(False)
+    np.testing.assert_allclose(m_host.get_parameter("emb", "weight"),
+                               m_dev.get_parameter("emb", "weight"),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(m_host.get_parameter("head", "kernel"),
+                               m_dev.get_parameter("head", "kernel"),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_eval_uses_sparse_gather(devices):
     m = _build(offload=True)
     m.train_iteration()

@@ -39,6 +39,9 @@ while :; do
       && grep -q TPU_OK /tmp/tpu_probe.out; then
     echo "tpu_watch: TPU healthy at $(date -u +%FT%TZ) (probe #$n) — firing chip_session"
     touch /tmp/TPU_ALIVE
+    # a stale bench line from an earlier window must not satisfy the
+    # fully-converted check below if this session wedges before bench
+    rm -f /tmp/bench_line.json
     bash tools/chip_session.sh 2>&1 | tee /tmp/chip_session.log
     echo "tpu_watch: chip_session finished rc=$? at $(date -u +%FT%TZ)"
     # a wedge mid-window can leave the fit or the bench number unlanded
