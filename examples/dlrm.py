@@ -1,7 +1,8 @@
 """DLRM training example (reference: examples/cpp/DLRM, run_random.sh).
 
     python examples/dlrm.py -e 1 -b 256 --bf16 \
-        [--arch-embedding-size 1000000-1000000-...] [--arch-sparse-feature-size 64]
+        [--arch-embedding-size 1000000-1000000-...] [--arch-sparse-feature-size 64] \
+        [--host-embeddings] [--pipeline S [--pipeline-microbatches M]]
 """
 
 import sys
@@ -27,6 +28,8 @@ def main(argv=None):
     mlp_bot = [64, 512, 512, 64]
     mlp_top = [576, 1024, 1024, 1024, 1]
     host_embeddings = False
+    pipeline_stages = 0
+    pipeline_microbatches = 4
     i = 0
     while i < len(rest):
         if rest[i] == "--arch-embedding-size":
@@ -46,6 +49,12 @@ def main(argv=None):
             mlp_top = [int(v) for v in rest[i].split("-")]
         elif rest[i] == "--host-embeddings":
             host_embeddings = True
+        elif rest[i] == "--pipeline":
+            i += 1
+            pipeline_stages = int(rest[i])
+        elif rest[i] == "--pipeline-microbatches":
+            i += 1
+            pipeline_microbatches = int(rest[i])
         i += 1
 
     if host_embeddings:
@@ -66,6 +75,11 @@ def main(argv=None):
         model, cfg.batch_size, embedding_sizes=emb_sizes,
         embedding_bag_size=bag, sparse_feature_size=sparse_dim,
         mlp_bot=mlp_bot, mlp_top=mlp_top)
+    if pipeline_stages > 1:
+        # hetero compose: host-placed tables lift out of the ring as a
+        # head; the MLP/interaction stack pipelines (ADR-002 schedule)
+        model.set_pipeline(num_stages=pipeline_stages,
+                           num_microbatches=pipeline_microbatches)
     model.compile(ff.SGDOptimizer(model, lr=0.01),
                   ff.LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
                   [ff.MetricsType.ACCURACY, ff.MetricsType.MEAN_SQUARED_ERROR])

@@ -404,14 +404,6 @@ class FFModel:
         seg = kept
         if not seg:
             raise ValueError("pipeline: no ops to pipeline")
-        for op in seg:
-            if op.pc.host_placed and not self._pipe_host_drop_warned:
-                self._pipe_host_drop_warned = True
-                print(f"flexflow_tpu: host placement for {op.name} is "
-                      f"DROPPED inside the pipeline segment (stage "
-                      f"weights pack into the device ring buffer); only "
-                      f"row-sparse-eligible embeddings run host-side "
-                      f"ahead of the ring")
         head_names = {op.name for op in head}
         if req["names"] is not None:
             by_name = {op.name: op for op in seg}
@@ -467,6 +459,16 @@ class FFModel:
                       f"{dict(zip(self.machine.axis_names, self.machine.axis_sizes))}"
                       f"; running without pipelining")
             return
+        # warn only once the plan actually commits — bailing out above
+        # (inexpressible ring) keeps every placement intact
+        for op in seg:
+            if op.pc.host_placed and not self._pipe_host_drop_warned:
+                self._pipe_host_drop_warned = True
+                print(f"flexflow_tpu: host placement for {op.name} is "
+                      f"DROPPED inside the pipeline segment (stage "
+                      f"weights pack into the device ring buffer); only "
+                      f"row-sparse-eligible embeddings run host-side "
+                      f"ahead of the ring")
         self._pipeline_plan = {
             "stages": stages, "head": head, "degree": int(degree),
             "dp_degree": int(req["dp_degree"]),
@@ -1574,6 +1576,11 @@ class FFModel:
                     # dp degree instead (the lookup into the replicated
                     # gathered-row buffer distributes over batch)
                     out = op.output
+                    if plan is not None and out.guid in plan["seg_in_guids"]:
+                        # hetero head feeding the pipeline ring: segment
+                        # ops carry no-split placeholder pcs, so the
+                        # plan's dp degree is the batch sharding
+                        return plan["dp_degree"]
                     for o2 in self.ops:
                         if out in o2.inputs \
                                 and o2.name not in self._host_embed:

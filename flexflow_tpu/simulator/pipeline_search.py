@@ -49,25 +49,45 @@ from .cost_model import CostModel
 from .machine import TPUMachineModel
 
 
+def _intended_host_placed(model, op) -> bool:
+    """Will compile place ``op`` host-side?  ``op.pc`` when assigned;
+    otherwise the configured strategy — search_pipeline runs BEFORE
+    per-op pc resolution (compile calls it first) and offline tools
+    search uncompiled models, so reading op.pc alone would make the
+    hetero-head hoist dead in every real call path."""
+    pc = getattr(op, "pc", None)
+    if pc is None:
+        pc = model.config.find_parallel_config(op.output.num_dims, op.name)
+    return bool(pc is not None and getattr(pc, "host_placed", False))
+
+
 def _pipeline_segment(model):
     """(segment ops, tail ops, head ops) matching FFModel._plan_pipeline:
     trailing Softmax stays outside, host-placed row-sparse embeddings
     run host-side AHEAD of the ring (hetero head — their outputs feed
-    stage 0 like extra inputs; their cost rides the parallel host
-    timeline, priced by the dim search's host tier, not the ring).
-    None when the chain has unsupported structure."""
+    stage 0 like extra inputs).  None when the chain has unsupported
+    structure."""
     seg = list(model.ops)
     tail = []
     while seg and seg[-1]._type == "Softmax":
         tail.insert(0, seg.pop())
-    # the STRICT runtime predicate (matching _plan_pipeline): pricing a
-    # hoisted head the runtime would stream table-scaled would bias the
-    # search toward a plan that executes much slower
-    eligible = getattr(model, "_sparse_embed_ok", lambda _: False)
-    head = [op for op in seg
-            if op._type == "Embedding"
-            and getattr(getattr(op, "pc", None), "host_placed", False)
-            and eligible(op)]
+    # mirror the runtime hoist predicate on INTENDED placement:
+    # candidate_ok covers the strategy-independent checks (own table,
+    # graph-input index, every index consumer an own-table Embedding),
+    # and all of the shared index's consumers must also be host-bound —
+    # a device-placed sibling makes the runtime stream table-scaled
+    eligible = getattr(model, "_sparse_embed_candidate_ok",
+                       lambda _: False)
+
+    def hoists(op):
+        if not (op._type == "Embedding" and _intended_host_placed(model, op)
+                and eligible(op)):
+            return False
+        idx_t = op.inputs[0]
+        return all(_intended_host_placed(model, o) for o in model.ops
+                   if any(t is idx_t for t in o.inputs))
+
+    head = [op for op in seg if hoists(op)]
     head_ids = {id(op) for op in head}
     seg = [op for op in seg if id(op) not in head_ids]
     if len(seg) < 2:
@@ -97,7 +117,7 @@ def _stage_prep(model, S: int):
             list(model.input_tensors) + [op.output for op in head])
     except ValueError:
         return None  # non-topological partition
-    return stages, seg_ins, boundaries
+    return stages, seg_ins, boundaries, head
 
 
 def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
@@ -128,7 +148,7 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
         prep = _stage_prep(model, S)
     if prep is None:
         return None
-    stages, seg_ins, boundaries = prep
+    stages, seg_ins, boundaries, head = prep
 
     # per-slot per-microbatch compute: cost the op at batch degree
     # batch/mb (so the sub-shape's leading dim is the microbatch size)
@@ -175,13 +195,25 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     t_sync = (machine.allreduce_time(list(range(dp)), 4.0 * w_elems)
               if dp > 1 else 0.0)
 
+    # hetero head: host tables gather/scatter on the host timeline,
+    # which the runtime OVERLAPS with the device ring (async swap-in /
+    # scatter-back) — the step costs the slower of the two timelines.
+    # Omitting this entirely would report "pipeline beats dims" for
+    # host-transfer-bound plans that execute slower.
+    t_head = 0.0
+    if head:
+        hpc = ParallelConfig.host_rowsparse()
+        t_head = sum(cost.op_time(op, hpc, "forward")
+                     + cost.op_time(op, hpc, "backward") for op in head)
+
     ticks = M + S - 1
     carry_bytes = cost._dtype_bytes * mb * pad
     best = None
     for rm in ((False, True) if remat is None else (remat,)):
         # both scans pay the ring; remat's bwd tick recomputes the fwd
-        t_pipe = ticks * (t_f + t_b + 2.0 * t_comm
-                          + (t_f if rm else 0.0)) + t_sync
+        t_pipe = max(ticks * (t_f + t_b + 2.0 * t_comm
+                              + (t_f if rm else 0.0)) + t_sync,
+                     t_head)
         # HBM budget: weights (f32 master + grad + optimizer slot) plus
         # scan residuals alive at the fwd->bwd turnaround — every
         # tick's stash (interiors drop out under remat)

@@ -309,3 +309,97 @@ def test_two_process_row_sparse_host_embeddings(devices):
     ref = (float(np.sum(np.abs(w))), float(np.sum(w * w)),
            float(np.sum(np.abs(h))))
     np.testing.assert_allclose(fprints[0], ref, rtol=1e-4)
+
+
+_CHILD_SPARSE_PIPE = """
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+sys.path.insert(0, {root!r})
+import flexflow_tpu as ff
+from flexflow_tpu.config import DeviceType
+from flexflow_tpu.parallel import distributed as dist
+
+dist.initialize()
+pid = jax.process_index()
+
+cfg = ff.FFConfig(batch_size=16, workers_per_node=4, num_nodes=2)
+cfg.strategies['emb'] = ff.ParallelConfig(DeviceType.CPU, (1, 1), (0,))
+m = ff.FFModel(cfg)
+ids = m.create_tensor((16, 4), dtype='int32', name='ids')
+t = m.embedding(ids, 1000, 8, name='emb')
+t = m.dense(t, 24, activation='relu', name='fc1')
+t = m.dense(t, 24, activation='relu', name='fc2')
+t = m.dense(t, 4, name='fc3')
+m.softmax(t, name='sm')
+m.set_pipeline(num_stages=2, num_microbatches=4, dp_degree=2)
+m.compile(ff.SGDOptimizer(m, lr=0.1),
+          ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+          [ff.MetricsType.ACCURACY])
+if m._pipeline_plan is None:
+    print('PIPESKIP', pid, flush=True)
+    dist.shutdown()
+    sys.exit(0)
+m.init_layers(seed=7)
+assert 'emb' in m._host_embed, 'hetero head not taken'
+assert [o.name for o in m._pipeline_plan['head']] == ['emb']
+
+rng = np.random.default_rng(0)
+X = rng.integers(0, 1000, (16, 4)).astype(np.int32)
+Y = (X[:, 0] % 4).astype(np.int32)[:, None]
+half = 8
+lo, hi = pid * half, (pid + 1) * half
+for _ in range(4):
+    m.set_batch({{ids: X[lo:hi]}}, Y[lo:hi])
+    m.train_iteration()
+m.sync()
+w = m.get_parameter('emb', 'weight')
+h = m.get_parameter('fc3', 'kernel')
+print('FPRINT', pid, float(np.sum(np.abs(w))), float(np.sum(w * w)),
+      float(np.sum(np.abs(h))), flush=True)
+dist.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_two_process_hetero_head_pipeline(devices):
+    """The full hetero composition at multi-process scale: row-sharded
+    host tables (hetero head ahead of the ring) x GPipe over each
+    host's local devices x dp over DCN — fingerprints agree across
+    controllers AND match a single-process run of the same plan."""
+    fprints, skipped = _run_two_controllers(_CHILD_SPARSE_PIPE)
+    if skipped:
+        pytest.skip("pipeline plan not expressible on the dcn x ici mesh")
+    np.testing.assert_allclose(fprints[0], fprints[1], rtol=1e-5)
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.config import DeviceType
+
+    cfg = ff.FFConfig(batch_size=16, workers_per_node=8)
+    cfg.strategies["emb"] = ff.ParallelConfig(DeviceType.CPU, (1, 1), (0,))
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((16, 4), dtype="int32", name="ids")
+    t = m.embedding(ids, 1000, 8, name="emb")
+    t = m.dense(t, 24, activation="relu", name="fc1")
+    t = m.dense(t, 24, activation="relu", name="fc2")
+    t = m.dense(t, 4, name="fc3")
+    m.softmax(t, name="sm")
+    m.set_pipeline(num_stages=2, num_microbatches=4, dp_degree=2)
+    m.compile(ff.SGDOptimizer(m, lr=0.1),
+              ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [ff.MetricsType.ACCURACY])
+    m.init_layers(seed=7)
+    assert "emb" in m._host_embed
+    rng = np.random.default_rng(0)
+    X = rng.integers(0, 1000, (16, 4)).astype(np.int32)
+    Y = (X[:, 0] % 4).astype(np.int32)[:, None]
+    for _ in range(4):
+        m.set_batch({ids: X}, Y)
+        m.train_iteration()
+    m.sync()
+    w = m.get_parameter("emb", "weight")
+    h = m.get_parameter("fc3", "kernel")
+    ref = (float(np.sum(np.abs(w))), float(np.sum(w * w)),
+           float(np.sum(np.abs(h))))
+    np.testing.assert_allclose(fprints[0], ref, rtol=1e-4)
