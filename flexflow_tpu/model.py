@@ -100,6 +100,9 @@ class FFModel:
         # arrays and writes them home; _he_join() is the read barrier
         self._he_pool = None
         self._he_pending = None
+        self._he_version = 0  # bumps when host-table rows change
+        self._he_dev_cache = None  # decode's device copy of host tables
+        self._dp_cache = None      # decode's unpacked-pipe params tree
         self.label_tensor: Optional[Tensor] = None
         self.machine: Optional[Machine] = None
         self.optimizer = None
@@ -1199,6 +1202,11 @@ class FFModel:
         self._he_join()  # at most one step in flight
         self._he_pending = self._he_pool.submit(
             self._he_write_rows, step_params, step_opt, ctxs)
+        # decode's device-table cache invalidates; drop it NOW so the
+        # full replicated device tables don't sit in HBM through a
+        # training run between generate calls
+        self._he_version += 1
+        self._he_dev_cache = None
         return new_params, new_opt
 
     @staticmethod
@@ -1841,19 +1849,47 @@ class FFModel:
     def _decode_params(self):
         """Params tree for decoding: a pipelined model's packed stage
         weights unpack to per-op entries (the decode runner walks ops
-        sequentially, not the GPipe ring).  Cached until a train step or
-        restore replaces ``_params``."""
+        sequentially, not the GPipe ring), and host-resident embedding
+        tables move to device ONCE per table version — generated ids
+        are data-dependent, so the row-sparse pre-gather is impossible
+        and feeding the numpy table into jit would re-upload the whole
+        table every generate call.  Cached until a train step or restore
+        replaces ``_params`` / bumps the table version."""
         # read barrier: decode reads host-resident tables the async
         # scatter-back may still be writing
         self._he_join()
-        if self._pipe_pack() is None:
-            return self._params
-        cached = getattr(self, "_dp_cache", None)
-        if cached is not None and cached[0] is self._params:
-            return cached[1]
-        from .runtime.checkpoint import _unpack_tree
-        tree = _unpack_tree(self, self._params)
-        self._dp_cache = (self._params, tree)
+        tree = self._params
+        if self._pipe_pack() is not None:
+            cached = getattr(self, "_dp_cache", None)
+            if cached is not None and cached[0] is self._params:
+                tree = cached[1]
+            else:
+                from .runtime.checkpoint import _unpack_tree
+                tree = _unpack_tree(self, self._params)
+                self._dp_cache = (self._params, tree)
+        if self._host_embed:
+            # keyed on the SOURCE TREE OBJECT (kept alive in the cache —
+            # a raw id() could be recycled and false-hit, which in a
+            # multi-process run would even diverge per rank around the
+            # assemble collective) plus the table version
+            cached = getattr(self, "_he_dev_cache", None)
+            if (cached is None or cached[0] is not tree
+                    or cached[1] != self._he_version):
+                src = tree
+                rep = self.machine.replicated()
+                tree = {k: (dict(v) if isinstance(v, dict) else v)
+                        for k, v in tree.items()}
+                for opn, info in self._host_embed.items():
+                    wn = info["weight"]
+                    shard = tree[opn][wn]
+                    if not isinstance(shard, np.ndarray):
+                        continue
+                    full = (self._he_assemble_full(info, shard)
+                            if jax.process_count() > 1 else shard)
+                    tree[opn][wn] = jax.device_put(
+                        np.ascontiguousarray(full), rep)
+                self._he_dev_cache = (src, self._he_version, tree)
+            tree = self._he_dev_cache[2]
         return tree
 
     def _check_position_table(self, pos_t, s_max: int) -> None:
@@ -2297,6 +2333,10 @@ class FFModel:
             new = self._pack_write(jnp.asarray(cur), e,
                                    jnp.asarray(value, jnp.float32))
             self._params["_pipe"]["buffer"] = jax.device_put(new, cur.sharding)
+            # in-place rebind keeps id(self._params): the identity-keyed
+            # decode caches would otherwise serve the pre-set weight
+            self._dp_cache = None
+            self._he_dev_cache = None
             return
         cur = self._params[op_name][weight_name]
         if isinstance(cur, np.ndarray):  # row-sparse host-resident table
@@ -2305,6 +2345,8 @@ class FFModel:
                 value = np.asarray(value)[info["row_lo"]:info["row_hi"]]
             self._params[op_name][weight_name] = np.asarray(
                 value, dtype=cur.dtype).reshape(cur.shape).copy()
+            self._he_version += 1
+            self._he_dev_cache = None
             return
         self._params[op_name][weight_name] = jax.device_put(
             jnp.asarray(value, dtype=cur.dtype), cur.sharding)

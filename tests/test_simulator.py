@@ -177,3 +177,24 @@ def test_host_embedding_cost_scales_with_batch_not_table(devices):
     # backward adds the PCIe return + host scatter
     t_bwd = cost.op_time(emb_op(64, 10_000), cpu_pc, "backward")
     assert t_bwd > t_small
+
+
+def test_host_embedding_prices_transfer_latency(devices):
+    """The fitted per-transfer host<->device latency (tens of ms behind
+    the tunnel) must raise the host-embedding cost — without it the
+    search over-recommends host placement on latency-bound deployments."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.simulator.cost_model import CostModel
+    from flexflow_tpu.simulator.machine import TPUMachineModel
+
+    cfg = ff.FFConfig(batch_size=64)
+    m = ff.FFModel(cfg)
+    ids = m.create_tensor((64, 4), dtype="int32", name="ids")
+    m.embedding(ids, 10000, 16, name="emb")
+    op = m.ops[0]
+    pc = ff.ParallelConfig.host_rowsparse()
+    base = CostModel(TPUMachineModel(num_devices=8),
+                     measure=False).op_time(op, pc, "forward")
+    slow = CostModel(TPUMachineModel(num_devices=8, host_xfer_latency=30e-3),
+                     measure=False).op_time(op, pc, "forward")
+    assert slow > base + 25e-3

@@ -212,6 +212,27 @@ def test_async_scatter_back_overlaps(devices):
     assert m._he_pending is None
 
 
+def test_decode_params_device_caches_host_table(devices):
+    """generate()'s ids are data-dependent, so decode cannot pre-gather
+    rows — _decode_params moves the host table to device ONCE per table
+    version instead of re-feeding the numpy table into jit per call."""
+    import jax as _jax
+
+    m = _build(offload=True)
+    dp = m._decode_params()
+    assert isinstance(dp["emb"]["weight"], _jax.Array)
+    assert m._decode_params()["emb"]["weight"] is dp["emb"]["weight"]
+    m.train_iteration()
+    m.sync()
+    dp3 = m._decode_params()
+    # invalidated by the step's row writes, and reflects them
+    assert dp3["emb"]["weight"] is not dp["emb"]["weight"]
+    np.testing.assert_array_equal(np.asarray(dp3["emb"]["weight"]),
+                                  m.get_parameter("emb", "weight"))
+    # the training path's table stays host-resident numpy
+    assert isinstance(m._params["emb"]["weight"], np.ndarray)
+
+
 def test_host_table_composes_with_pipeline(devices):
     """Hetero pipeline (reference dlrm_strategy_hetero.cc: CPU tables +
     accelerator pipeline): a host-placed row-sparse embedding is lifted

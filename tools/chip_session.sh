@@ -85,16 +85,21 @@ rm -rf /tmp/flexflow_tpu_trace
 timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
 
 # 7. commit the measurement artifacts so a window that converts while
-# nobody is watching still lands durably (data files only — no source)
-git add -f BENCH_EXTRA.json CALIBRATION.md REPORT_SOAP.md \
-    REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md 2>/dev/null || true
-git add -f BENCH_SWEEP.md 2>/dev/null || true
-git add -f flexflow_tpu/simulator/measured_v5e.json \
-    flexflow_tpu/simulator/machine_v5e.json 2>/dev/null || true
-# pathspec-limited: unrelated staged changes must never be swept into a
-# commit asserting "data files only"
-if ! git diff --cached --quiet; then
-  git commit -m "Record on-chip calibration, bench, and agreement artifacts
+# nobody is watching still lands durably (data files only — no source).
+# Pathspec-limited to the artifacts that EXIST: unrelated staged changes
+# must never be swept into a commit asserting "data files only", and a
+# missing optional artifact (e.g. SKIP_SWEEP) must not abort the commit.
+ARTS=""
+for f in BENCH_EXTRA.json BENCH_SWEEP.md CALIBRATION.md REPORT_SOAP.md \
+         REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
+         flexflow_tpu/simulator/measured_v5e.json \
+         flexflow_tpu/simulator/machine_v5e.json; do
+  [ -f "$f" ] && ARTS="$ARTS $f"
+done
+if [ -n "$ARTS" ]; then
+  git add -f $ARTS || true
+  if ! git diff --cached --quiet -- $ARTS; then
+    git commit -m "Record on-chip calibration, bench, and agreement artifacts
 
 Measurement data from a healthy-chip window captured by
 tools/chip_session.sh: fitted machine constants, measured op costs,
@@ -102,11 +107,8 @@ bench numbers, SOAP reports with measured provenance, and the
 single-chip simulated-vs-measured agreement bound.
 
 No-Verification-Needed: measurement artifacts only, no source changes" \
-    -- BENCH_EXTRA.json BENCH_SWEEP.md CALIBRATION.md REPORT_SOAP.md \
-    REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
-    flexflow_tpu/simulator/measured_v5e.json \
-    flexflow_tpu/simulator/machine_v5e.json \
-    || true
+      -- $ARTS || true
+  fi
 fi
 
 echo "chip_session: done"
