@@ -239,6 +239,25 @@ def run_dlrm_host(batch_size=256, steps=8, tables=8, rows=1_000_000):
         model.train_iteration()
     model.sync()
     dt = time.perf_counter() - t0
+    # A/B the async scatter-back: serialize it with the step and
+    # re-time — the delta is the overlap's measured win (on the tunnel,
+    # where each host<->device sync costs tens of ms, this is the
+    # feature's whole case)
+    prior = os.environ.get("FF_HE_SYNC_SCATTER")
+    os.environ["FF_HE_SYNC_SCATTER"] = "1"
+    try:
+        model.train_iteration()
+        model.sync()
+        t1 = time.perf_counter()
+        for _ in range(steps):
+            model.train_iteration()
+        model.sync()
+        dt_sync = time.perf_counter() - t1
+    finally:
+        if prior is None:
+            os.environ.pop("FF_HE_SYNC_SCATTER", None)
+        else:
+            os.environ["FF_HE_SYNC_SCATTER"] = prior
     # per-step host<->device row traffic (both directions, f32 rows):
     # the wire carries the ADAPTIVE bucket (u_hwm), not the all-unique
     # worst case; report actual unique rows alongside
@@ -249,6 +268,9 @@ def run_dlrm_host(batch_size=256, steps=8, tables=8, rows=1_000_000):
     uniq_avg = sum(info.get("uniq_rows_total", 0)
                    for info in infos) / n_steps
     return {"samples_per_sec": round(steps * batch_size / dt, 1),
+            "samples_per_sec_sync_scatter": round(
+                steps * batch_size / dt_sync, 1),
+            "async_scatter_speedup": round(dt_sync / dt, 3),
             "tables_host_sparse": n_sparse,
             "table_bytes_total": int(sum(sizes) * 64 * 4),
             "row_traffic_bytes_per_step": int(u * 64 * 4 * 2),

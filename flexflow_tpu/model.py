@@ -29,6 +29,7 @@ update``) is preserved: the calls stage work and the fused step executes at
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -1207,6 +1208,10 @@ class FFModel:
         # training run between generate calls
         self._he_version += 1
         self._he_dev_cache = None
+        if os.environ.get("FF_HE_SYNC_SCATTER"):
+            # measurement knob: serialize the scatter-back with the step
+            # (bench A/Bs this to report the async overlap's actual win)
+            self._he_join()
         return new_params, new_opt
 
     @staticmethod

@@ -279,6 +279,19 @@ def test_host_table_composes_with_pipeline(devices):
                                rtol=2e-4, atol=2e-5)
 
 
+def test_sync_scatter_knob(devices, monkeypatch):
+    """FF_HE_SYNC_SCATTER=1 serializes the scatter-back with the step —
+    the measurement knob bench.py A/Bs to report the async overlap's
+    actual win."""
+    m = _build(offload=True)
+    monkeypatch.setenv("FF_HE_SYNC_SCATTER", "1")
+    m.train_iteration()
+    assert m._he_pending is None  # joined before update() returned
+    monkeypatch.delenv("FF_HE_SYNC_SCATTER")
+    m.train_iteration()
+    assert m._he_pending is not None  # async again
+
+
 def test_eval_uses_sparse_gather(devices):
     m = _build(offload=True)
     m.train_iteration()
