@@ -1079,9 +1079,10 @@ class FFModel:
         DLRM norm, never pay worst-case all-unique padding, and the
         monotone ladder bounds jit retraces to the handful of distinct
         bucket shapes), remap the index batch to the compact row space,
-        and gather the same rows of any table-shaped optimizer slot.  The dense in-jit optimizer update
-        then IS the lazy per-touched-row update, and
-        ``_host_embed_scatter_back`` writes the rows home in place."""
+        and gather the same rows of any table-shaped optimizer slot.
+        The dense in-jit optimizer update then IS the lazy
+        per-touched-row update, and ``_host_embed_scatter_back`` writes
+        the rows home in place."""
         rep = self.machine.replicated()
         params_in = _copy_params_tree(params_in)
         batch_in = dict(batch)
@@ -1125,9 +1126,15 @@ class FFModel:
                 info["u_hwm"] = u
                 info["uniq_rows_total"] = info.get("uniq_rows_total", 0) + n
                 info["uniq_rows_steps"] = info.get("uniq_rows_steps", 0) + 1
+            deg = info.get("batch_degree")
+            if deg is None:
+                # fixed after compile; the consumer scan inside
+                # _input_batch_degree is O(ops) and this runs per table
+                # per step on the Python hot path
+                deg = info["batch_degree"] = \
+                    self._input_batch_degree(info["input"])
             batch_in[key] = self._place_batch(
-                inv.reshape(idx.shape).astype(np.int32),
-                self._input_batch_degree(info["input"]))
+                inv.reshape(idx.shape).astype(np.int32), deg)
             preps.append((opn, info, uniq, n, u))
         # read barrier: the previous step's rows must be home before the
         # tables are gathered
