@@ -5,7 +5,8 @@ baked into the XLA CPU client at init, so 16-device coverage runs in
 subprocesses with their own XLA_FLAGS.  Covers the two BASELINE configs
 that specify 16 cores: AlexNet-style SOAP hybrid (via dryrun_multichip)
 and NMT at reference size (hidden 2048, vocab 20k — nmt/nmt.cc:34-44)
-with hidden-TP LSTM over a dp4×tp4 mesh.
+with hidden-TP LSTM over a dp4×tp4 mesh, plus hetero DLRM (8 host
+row-sparse tables ahead of a dp4×pp4 remat ring).
 """
 
 import os
@@ -75,3 +76,48 @@ print('nmt16: ok', spec)
 """, timeout=1500)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "nmt16: ok" in r.stdout
+
+
+@pytest.mark.slow
+def test_hetero_head_dlrm_16dev():
+    """Reference-shaped hetero DLRM at 16 devices: 8 host-resident
+    row-sparse tables lift out of a dp4 x pp4 GPipe ring (the
+    dlrm_strategy_hetero.cc layout at the run_summit.sh scale)."""
+    r = _run16("""
+import sys
+sys.path.insert(0, '.')
+import numpy as np
+import flexflow_tpu as ff
+from flexflow_tpu.models.dlrm import build_dlrm, synthetic_batch
+
+sizes = [20000] * 8
+cfg = ff.FFConfig(batch_size=256, workers_per_node=16)
+for i in range(8):
+    cfg.strategies[f'embedding{i}'] = ff.ParallelConfig.host_rowsparse()
+m = ff.FFModel(cfg)
+sparse_in, dense_in, _ = build_dlrm(m, 256, embedding_sizes=sizes)
+m.set_pipeline(num_stages=4, num_microbatches=8, dp_degree=4, remat=True)
+m.compile(ff.SGDOptimizer(m, lr=0.01),
+          ff.LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+          [ff.MetricsType.MEAN_SQUARED_ERROR])
+m.init_layers()
+assert len(m._host_embed) == 8, m._host_embed
+assert m._pipeline_plan is not None
+assert len(m._pipeline_plan['head']) == 8
+assert m._pipeline_plan['remat'] is True
+assert m._pipeline_plan['degree'] == 4 and m._pipeline_plan['dp_degree'] == 4
+sparse, dense, labels = synthetic_batch(256, sizes, 1, 64)
+inputs = {t: a for t, a in zip(sparse_in, sparse)}
+inputs[dense_in] = dense
+m.set_batch(inputs, labels)
+m.train_iteration()
+m.train_iteration()
+m.sync()
+# tables stayed host-resident through pipelined training
+assert all(isinstance(m._params[f'embedding{i}']['weight'], np.ndarray)
+           for i in range(8))
+print('hetero16: ok, head', len(m._pipeline_plan['head']),
+      'ring', m._pipeline_plan['degree'])
+""", timeout=1500)
+    assert r.returncode == 0, r.stderr[-2500:]
+    assert "hetero16: ok" in r.stdout
