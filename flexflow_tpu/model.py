@@ -1460,22 +1460,37 @@ class FFModel:
         return out
 
     def _init_opt_state(self):
-        # zeros_like does not carry memory kinds: pin offloaded entries'
-        # state to host explicitly so every step sees consistent kinds.
         params = self._params
+        if self._offload or self._host_embed:
+            params = {opn: (dict(ws) if isinstance(ws, dict) else ws)
+                      for opn, ws in params.items()}
+        if self._offload:
+            # zeros_like cannot materialize a pinned-host buffer (jax
+            # builds arrays from callbacks in default device memory
+            # only), so every stateful optimizer would crash at init on
+            # an offloaded weight.  Hand init_state a device-memory
+            # stand-in of the same shape/dtype/layout; the created
+            # state streams to pinned host right below, exactly like
+            # the weights do.
+            for (opn, wn), (host_sh, dev_sh) in self._offload.items():
+                ws = params.get(opn)
+                if isinstance(ws, dict) and wn in ws:
+                    leaf = ws[wn]
+                    # allocate shard-wise directly — a device_put of a
+                    # full single-device zeros buffer could OOM device 0
+                    # for exactly the weights offload exists to hold
+                    ws[wn] = jnp.zeros(leaf.shape, leaf.dtype,
+                                       device=dev_sh)
         if self._host_embed:
             # Host-resident tables stay OUT of init_state (zeros_like
             # would allocate a table-sized device buffer); their state
             # lives host-side as numpy, scatter-updated per step.
-            params = {opn: ws for opn, ws in params.items()}
             tables = {}
             for opn, info in self._host_embed.items():
                 wn = info["weight"]
-                d = dict(params[opn])
+                d = params[opn]
                 tables[(opn, wn)] = d.pop(wn)
-                if d:
-                    params[opn] = d
-                else:
+                if not d:
                     params.pop(opn)
             state = self.optimizer.init_state(params)
             for v in state.values():
@@ -1483,10 +1498,11 @@ class FFModel:
                     for (opn, wn), tbl in tables.items():
                         v.setdefault(opn, {})[wn] = np.zeros(tbl.shape,
                                                              np.float32)
-            state = self._offload_put_state(state, True)
         else:
-            state = self._offload_put_state(
-                self.optimizer.init_state(self._params), True)
+            state = self.optimizer.init_state(params)
+        # pin offloaded entries' state to host so every step sees
+        # consistent memory kinds
+        state = self._offload_put_state(state, True)
         zero_specs = getattr(self.optimizer, "zero_specs", None)
         if zero_specs:
             mesh = self.machine.mesh
