@@ -72,13 +72,19 @@ class ParallelConfig:
             raise ValueError(f"partition degrees must be >= 1, got {self.dims}")
 
     @classmethod
-    def host_rowsparse(cls) -> "ParallelConfig":
+    def host_rowsparse(cls, ndims: int = 2) -> "ParallelConfig":
         """Host placement for an embedding table (reference: the hetero
         DLRM strategies' CPU + ZC-memory placement,
         dlrm_strategy_hetero.cc:28-35) — the runtime's row-sparse
         host-resident path.  ONE definition shared by the strategy
-        generators, both search engines, and the SOAP reports."""
-        return cls(DeviceType.CPU, (1, 1), (0,), ("host", "host", "host"))
+        generators, both search engines, and the SOAP reports.
+
+        ``ndims``: rank of the embedding's OUTPUT (2 for SUM/AVG bags,
+        3 for aggr=NONE sequence lookups) — ``find_parallel_config``
+        silently drops rank-mismatched entries, so a rank-2 config on a
+        rank-3 embedding would lose the host placement entirely."""
+        return cls(DeviceType.CPU, (1,) * max(2, int(ndims)), (0,),
+                   ("host", "host", "host"))
 
     @property
     def host_placed(self) -> bool:

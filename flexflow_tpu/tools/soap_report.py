@@ -93,15 +93,32 @@ def main(argv: Optional[List[str]] = None):
     # host-placing an ineligible table would price the row-sparse path
     # for a plan that actually executes as full-table streaming
     het_rt = None
+    het_pipe = None
     eligible = getattr(model, "_sparse_embed_candidate_ok",
                        lambda _: False)
     elig = {op.name for op in model.ops
             if op._type == "Embedding" and eligible(op)}
     if elig:
-        het = {op.name: (ParallelConfig.host_rowsparse()
+        het = {op.name: (ParallelConfig.host_rowsparse(op.output.num_dims)
                          if op.name in elig else dp[op.name])
                for op in model.ops}
         het_rt = sim.simulate_runtime(model, het)
+        # the COMBINED layout the runtime executes as a hetero head:
+        # host tables ahead of a GPipe ring over the dense rest — built
+        # on a twin model whose config carries the host placements, so
+        # search_pipeline's intended-placement hoist fires
+        mh = build_model(args.model, args.batch_size, args.devices)
+        mh.config.compute_dtype = args.compute_dtype
+        rank_of = {op.name: op.output.num_dims for op in model.ops}
+        for name in elig:
+            mh.config.strategies[name] = \
+                ParallelConfig.host_rowsparse(rank_of[name])
+        het_pipe = search_pipeline(mh, machine_model=mm)
+        if het_pipe is not None and pipe_plan is not None \
+                and het_pipe == pipe_plan:
+            # hoist didn't change the plan — don't print a duplicate
+            # row claiming tables were hoisted
+            het_pipe = None
 
     # provenance: how much of the final strategies' costs are measured
     prov_cost = CostModel(mm, measure=False,
@@ -191,6 +208,14 @@ def main(argv: Optional[List[str]] = None):
             f"| hetero host-embedding (row-sparse tables, "
             f"dlrm_strategy_hetero) | {het_rt * 1e3:.3f} ms | "
             f"{dp_rt / het_rt:.2f}x |")
+    if het_pipe is not None:
+        lines.append(
+            f"| hetero head + pipeline ({het_pipe['num_stages']} stages "
+            f"x dp{het_pipe['dp_degree']}, "
+            f"M={het_pipe['num_microbatches']}"
+            f"{', remat' if het_pipe.get('remat') else ''}; host tables "
+            f"ahead of the ring) | {het_pipe['simulated_s'] * 1e3:.3f} ms "
+            f"| {dp_rt / het_pipe['simulated_s']:.2f}x |")
     lines.append("")
     if agree:
         lines += [
