@@ -70,7 +70,7 @@ def enumerate_candidates(op, nd: int, model=None) -> List[ParallelConfig]:
                 cands.append(ParallelConfig(dims=degrees).with_device_ids(ids))
     if model is not None and getattr(model, "_sparse_embed_candidate_ok",
                                      lambda _: False)(op):
-        cands.append(ParallelConfig.host_rowsparse())
+        cands.append(ParallelConfig.host_rowsparse(op.output.num_dims))
     return cands
 
 

@@ -202,9 +202,11 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     # host-transfer-bound plans that execute slower.
     t_head = 0.0
     if head:
-        hpc = ParallelConfig.host_rowsparse()
-        t_head = sum(cost.op_time(op, hpc, "forward")
-                     + cost.op_time(op, hpc, "backward") for op in head)
+        t_head = sum(
+            cost.op_time(op, ParallelConfig.host_rowsparse(
+                op.output.num_dims), "forward")
+            + cost.op_time(op, ParallelConfig.host_rowsparse(
+                op.output.num_dims), "backward") for op in head)
 
     ticks = M + S - 1
     carry_bytes = cost._dtype_bytes * mb * pad

@@ -78,7 +78,7 @@ def random_parallel_config(op, num_devices: int, rng: random.Random,
     if model is not None and rng.random() < 0.1 \
             and getattr(model, "_sparse_embed_candidate_ok",
                         lambda _: False)(op):
-        return ParallelConfig.host_rowsparse()
+        return ParallelConfig.host_rowsparse(op.output.num_dims)
     rank = op.output.num_dims
     splittable = splittable_dims(op)
     num_parts = rng.choice(_divisors(num_devices))
