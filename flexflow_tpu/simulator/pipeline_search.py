@@ -203,10 +203,10 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     t_head = 0.0
     if head:
         t_head = sum(
-            cost.op_time(op, ParallelConfig.host_rowsparse(
-                op.output.num_dims), "forward")
-            + cost.op_time(op, ParallelConfig.host_rowsparse(
-                op.output.num_dims), "backward") for op in head)
+            cost.op_time(op, hpc, "forward")
+            + cost.op_time(op, hpc, "backward")
+            for op in head
+            for hpc in [ParallelConfig.host_rowsparse(op.output.num_dims)])
 
     ticks = M + S - 1
     carry_bytes = cost._dtype_bytes * mb * pad

@@ -16,8 +16,10 @@ from flexflow_tpu.config import DeviceType
 
 
 def _build(offload: bool, rows: int = 1000, momentum: float = 0.0,
-           sparse=None, batch: int = 16, grad_accum: int = 1, seed: int = 11):
-    cfg = ff.FFConfig(batch_size=batch, grad_accum_steps=grad_accum)
+           sparse=None, batch: int = 16, grad_accum: int = 1, seed: int = 11,
+           fused: bool = False):
+    cfg = ff.FFConfig(batch_size=batch, grad_accum_steps=grad_accum,
+                      fused_optimizer=fused)
     cfg.sparse_host_embeddings = sparse
     if offload:
         cfg.strategies["emb"] = ff.ParallelConfig(
@@ -277,6 +279,28 @@ def test_host_table_composes_with_pipeline(devices):
     np.testing.assert_allclose(m_host.get_parameter("head", "kernel"),
                                m_dev.get_parameter("head", "kernel"),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_fused_optimizer_composes_with_host_table(devices):
+    """fused_optimizer=True routes dense weights through the Pallas
+    kernels while host tables take the plain (gather/scatter) update —
+    numerics match the unfused dense run."""
+    def run(host):
+        m = _build(host, rows=500, fused=True)
+        for _ in range(4):
+            m.train_iteration()
+        m.sync()
+        return m
+
+    m_h = run(True)
+    assert "emb" in m_h._host_embed
+    m_d = run(False)
+    np.testing.assert_allclose(m_h.get_parameter("emb", "weight"),
+                               m_d.get_parameter("emb", "weight"),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(m_h.get_parameter("head", "kernel"),
+                               m_d.get_parameter("head", "kernel"),
+                               rtol=2e-5, atol=2e-6)
 
 
 def test_sync_scatter_knob(devices, monkeypatch):
