@@ -445,17 +445,21 @@ def main(argv: Optional[List[str]] = None):
                         target_platform="tpu" if args.fit_only else platform)
 
     models, nds = [], []
-    # AlexNet: full SOAP candidate space at the target machine size …
-    m = _model("alexnet", args.alexnet_batch, args.devices)
-    models.append(m)
-    nds.append(args.devices)
-    jobs = candidate_jobs(m, args.devices, cost, full=True)
-    # … plus the single-chip bench shape (agreement check) …
+    # The tunnel wedges without warning, so a "window" is often only a
+    # few healthy minutes: order jobs so the highest-value entries land
+    # first.  Single-chip bench shapes lead (they are the agreement
+    # check AND the fit's anchor points), then the SOAP space + the
+    # Inception spread cheapest-analytic-first — small shapes compile
+    # and run fastest, landing the most fit points per minute, and the
+    # fitted roofline covers whatever a short window leaves unmeasured.
     mb = _model("alexnet", args.bench_batch, 1)
     models.append(mb)
     nds.append(1)
-    jobs += candidate_jobs(mb, 1, cost, full=False)
-    # … plus Inception DP shapes (bench model #2).
+    jobs = candidate_jobs(mb, 1, cost, full=False)
+    m = _model("alexnet", args.alexnet_batch, args.devices)
+    models.append(m)
+    nds.append(args.devices)
+    rest = candidate_jobs(m, args.devices, cost, full=True)
     if args.inception:
         mi = _model("inception", args.bench_batch, args.devices)
         models.append(mi)
@@ -468,7 +472,9 @@ def main(argv: Optional[List[str]] = None):
             # rest).
             stride = max(1, len(ijobs) // args.inception_jobs)
             ijobs = ijobs[::stride][:args.inception_jobs]
-        jobs += ijobs
+        rest += ijobs
+    rest.sort(key=lambda j: cost._analytic(j[0], j[1], j[2]))
+    jobs += rest
 
     print(f"[calibrate] {len(jobs)} measurement jobs "
           f"(cache: {len(cost._measured)} entries pre-loaded)", flush=True)
@@ -480,12 +486,16 @@ def main(argv: Optional[List[str]] = None):
         if args.skip_keys_file and os.path.exists(args.skip_keys_file):
             with open(args.skip_keys_file) as f:
                 skip = {ln.strip() for ln in f if ln.strip()}
-        run_measurements(jobs, cost, args.max_seconds,
-                         verbose=not args.quiet,
-                         heartbeat_path=args.heartbeat, skip_keys=skip)
+        # ladder first: it is seconds of work, uniquely valuable (the
+        # host-embedding path prices the measured tunnel rate, not the
+        # PCIe spec sheet), and must not sit behind a wedge-prone hour
+        # of op jobs
         measure_host_transfer(cost, verbose=not args.quiet,
                               heartbeat_path=args.heartbeat,
                               skip_keys=skip)
+        run_measurements(jobs, cost, args.max_seconds,
+                         verbose=not args.quiet,
+                         heartbeat_path=args.heartbeat, skip_keys=skip)
         if args.worker:
             # fit happens in the supervising parent, from the cache
             print(f"[calibrate] worker done: {len(cost._measured)} "
