@@ -40,6 +40,32 @@ sys.path.insert(0, ".")
 
 PER_CHIP_BASELINE = 375.0  # samples/s/chip parity bar (see docstring)
 PEAK_FLOPS = 197e12        # v5e bf16
+
+
+def _shared_bench_batch():
+    # Single source with calibrate/soap_report (the agreement check
+    # converts this phase's samples/s to ms/step with the SAME batch).
+    # Loaded by file path: importing flexflow_tpu.tools would execute
+    # the package __init__ (jax + the whole framework) at module load,
+    # outside the phase budgets and the watchdog's error reporting.
+    # Any failure falls back to the historical 256 — a bench that runs
+    # with a slightly stale constant beats one that dies before the
+    # wedge-proof primary-line protocol even starts.
+    try:
+        import importlib.util
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "flexflow_tpu", "tools", "report_configs.py")
+        spec = importlib.util.spec_from_file_location(
+            "_ff_report_configs", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return int(mod.BENCH_SINGLE_CHIP_BATCH)
+    except Exception:
+        return 256
+
+
+BENCH_SINGLE_CHIP_BATCH = _shared_bench_batch()
 TRANSFORMER_SEQ = 512      # bench transformer sequence length
 TRANSFORMER_VOCAB = 32000
 
@@ -185,7 +211,8 @@ def _build_warm(name, batch_size, compute_dtype, fused=False):
     return model
 
 
-def run_one(name, batch_size=256, compute_dtype="bfloat16", steps=24,
+def run_one(name, batch_size=BENCH_SINGLE_CHIP_BATCH,
+            compute_dtype="bfloat16", steps=24,
             fused=False):
     """(samples/s/chip, achieved TFLOPS, MFU) for one model's train loop."""
     import jax
@@ -359,7 +386,8 @@ def _extra_phases(extra):
     try:
         # fused Pallas optimizer kernels on the real chip (single
         # device): proves they compile+run outside interpret mode
-        sps_f, _, _ = run_one("alexnet", batch_size=256, steps=8, fused=True)
+        sps_f, _, _ = run_one("alexnet", steps=8, fused=True,
+                              batch_size=BENCH_SINGLE_CHIP_BATCH)
         extra["fused_optimizer"] = {
             "ok": True, "samples_per_sec_per_chip": round(sps_f, 2)}
     except Exception as e:
@@ -382,7 +410,7 @@ def profile(out="/tmp/flexflow_tpu_trace"):
     boundaries (view with TensorBoard or xprof)."""
     from flexflow_tpu.runtime.profiling import trace
 
-    model = _build_warm("alexnet", 256, "bfloat16")
+    model = _build_warm("alexnet", BENCH_SINGLE_CHIP_BATCH, "bfloat16")
     with trace(out):
         for _ in range(8):
             model.train_iteration()
@@ -436,14 +464,19 @@ def main():
     # ---- primary phase: nothing runs before this number is on stdout ----
     _enter_phase("alexnet")
     try:
-        sps_a, tf_a, mfu_a = run_one("alexnet", batch_size=256)
+        sps_a, tf_a, mfu_a = run_one("alexnet",
+                                     batch_size=BENCH_SINGLE_CHIP_BATCH)
     except Exception as e:
         _emit_primary(None, extra, error=f"{type(e).__name__}: {e}")
         _write_side_file()
         raise
     extra["alexnet"] = {"samples_per_sec_per_chip": round(sps_a, 2),
                         "achieved_tflops": round(tf_a, 1),
-                        "mfu": round(mfu_a, 3)}
+                        "mfu": round(mfu_a, 3),
+                        # recorded so the agreement check converts
+                        # samples/s -> ms/step with the batch this run
+                        # ACTUALLY used (chip_session.sh stage 3)
+                        "batch": BENCH_SINGLE_CHIP_BATCH}
     with _lock:
         _emit_primary(sps_a, {"alexnet": extra["alexnet"]}, mfu=mfu_a)
         _state["primary_printed"] = True

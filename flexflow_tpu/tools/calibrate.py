@@ -308,6 +308,7 @@ def collect_fit_records(models, nds, cost) -> List[Dict]:
                 out_vol = int(np.prod(sub))
                 recs.append({
                     "key": kf,
+                    "op": type(op).__name__,
                     "flops": float(flops),
                     "bytes": cost._dtype_bytes * (in_vol + w_vol + out_vol),
                     "t_fwd": cost._measured[kf],
@@ -340,6 +341,7 @@ def fit_machine(recs: List[Dict], machine) -> Dict[str, float]:
     ratios = [r["t_bwd"] / r["t_fwd"] for r in recs
               if r["t_bwd"] and r["t_fwd"] > 0]
     bwd_mult = float(np.median(ratios)) if ratios else 2.0
+    op_types = sorted({r.get("op", "?") for r in recs})
     fit = {
         "mxu_efficiency": eff,
         "hbm_bandwidth": machine.hbm_bandwidth * hbm_eff,
@@ -347,7 +349,18 @@ def fit_machine(recs: List[Dict], machine) -> Dict[str, float]:
         "backward_multiplier": bwd_mult,
         "fit_log_rmse": math.sqrt(err),
         "fit_points": len(recs),
+        "fit_op_types": op_types,
     }
+    from .report_configs import THIN_FIT_OP_TYPES, THIN_FIT_POINTS
+
+    if len(recs) < THIN_FIT_POINTS or len(op_types) < THIN_FIT_OP_TYPES:
+        # A thin basis (e.g. one conv family from a short window) still
+        # beats dataclass defaults, but its constants extrapolate — say
+        # so wherever the fit is consumed (reports echo these fields).
+        print(f"[calibrate] WARNING: thin fit basis — {len(recs)} points "
+              f"over op types {op_types}; constants extrapolate to "
+              "unmeasured op families until more windows land",
+              flush=True)
     return fit
 
 
@@ -355,12 +368,27 @@ def main(argv: Optional[List[str]] = None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--devices", type=int, default=16,
                    help="machine size the search will target")
-    p.add_argument("--alexnet-batch", type=int, default=1024,
-                   help="global batch for the 16-chip AlexNet config "
-                        "(64/chip × 16, the reference per-GPU batch)")
-    p.add_argument("--bench-batch", type=int, default=256,
+    from .report_configs import BENCH_SINGLE_CHIP_BATCH, REPORT_GLOBAL_BATCH
+
+    p.add_argument("--alexnet-batch", type=int,
+                   default=REPORT_GLOBAL_BATCH["alexnet"],
+                   help="global batch for the 16-chip AlexNet candidate "
+                        "space — shared default with soap_report "
+                        "(report_configs.py); a mismatch zeroes the "
+                        "report's measured provenance")
+    p.add_argument("--bench-batch", type=int,
+                   default=BENCH_SINGLE_CHIP_BATCH,
                    help="single-chip bench batch (measured for the "
                         "sim-vs-measured agreement check)")
+    p.add_argument("--models", default="alexnet,dlrm,nmt",
+                   help="comma list of models whose FULL SOAP candidate "
+                        "space is measured (the shapes the soap_report "
+                        "strategies price — matching configs is what "
+                        "makes measured provenance possible)")
+    p.add_argument("--report-batch", type=int, default=None,
+                   help="override the global batch for every non-alexnet "
+                        "candidate space (default: each model's entry in "
+                        "report_configs.py, shared with soap_report)")
     p.add_argument("--inception", action="store_true", default=True)
     p.add_argument("--no-inception", dest="inception", action="store_false")
     p.add_argument("--inception-jobs", type=int, default=48,
@@ -403,10 +431,13 @@ def main(argv: Optional[List[str]] = None):
         for flag, val in (("--devices", args.devices),
                           ("--alexnet-batch", args.alexnet_batch),
                           ("--bench-batch", args.bench_batch),
+                          ("--models", args.models),
+                          ("--report-batch", args.report_batch),
                           ("--inception-jobs", args.inception_jobs),
                           ("--compute-dtype", args.compute_dtype),
                           ("--max-seconds", args.max_seconds)):
-            fwd += [flag, str(val)]
+            if val is not None:
+                fwd += [flag, str(val)]
         if not args.inception:
             fwd.append("--no-inception")
         if args.out:
@@ -455,33 +486,51 @@ def main(argv: Optional[List[str]] = None):
     mb = _model("alexnet", args.bench_batch, 1)
     models.append(mb)
     nds.append(1)
-    jobs = candidate_jobs(mb, 1, cost, full=False)
-    m = _model("alexnet", args.alexnet_batch, args.devices)
-    models.append(m)
-    nds.append(args.devices)
-    rest = candidate_jobs(m, args.devices, cost, full=True)
+    jobs = [] if args.fit_only else candidate_jobs(mb, 1, cost, full=False)
+    rest = []
+    wanted = [s.strip() for s in args.models.split(",") if s.strip()]
+    for name in wanted:
+        if name == "alexnet":
+            bs = args.alexnet_batch
+        elif args.report_batch is not None:
+            bs = args.report_batch
+        else:
+            bs = REPORT_GLOBAL_BATCH.get(name, 1024)
+        mr = _model(name, bs, args.devices)
+        models.append(mr)
+        nds.append(args.devices)
+        if not args.fit_only:
+            rest += candidate_jobs(mr, args.devices, cost, full=True)
+    if "alexnet" in wanted and args.alexnet_batch != 1024:
+        # Fit-records only (never measured): the first converted window
+        # (round 5) cached batch-1024 alexnet shapes; enumerate that
+        # space too so those points keep feeding every future refit.
+        models.append(_model("alexnet", 1024, args.devices))
+        nds.append(args.devices)
     if args.inception:
         mi = _model("inception", args.bench_batch, args.devices)
         models.append(mi)
         nds.append(args.devices)
-        ijobs = candidate_jobs(mi, args.devices, cost, full=False)
-        if args.inception_jobs and len(ijobs) > args.inception_jobs:
-            # Even subsample: Inception entries feed the roofline fit and
-            # spot-checks, not the AlexNet SOAP search — a spread of its
-            # 94 conv shapes is enough (the fitted analytic covers the
-            # rest).
-            stride = max(1, len(ijobs) // args.inception_jobs)
-            ijobs = ijobs[::stride][:args.inception_jobs]
-        rest += ijobs
+        if not args.fit_only:
+            ijobs = candidate_jobs(mi, args.devices, cost, full=False)
+            if args.inception_jobs and len(ijobs) > args.inception_jobs:
+                # Even subsample: Inception entries feed the roofline fit
+                # and spot-checks, not the AlexNet SOAP search — a spread
+                # of its 94 conv shapes is enough (the fitted analytic
+                # covers the rest).
+                stride = max(1, len(ijobs) // args.inception_jobs)
+                ijobs = ijobs[::stride][:args.inception_jobs]
+            rest += ijobs
     rest.sort(key=lambda j: cost._analytic(j[0], j[1], j[2]))
     jobs += rest
 
-    print(f"[calibrate] {len(jobs)} measurement jobs "
-          f"(cache: {len(cost._measured)} entries pre-loaded)", flush=True)
     if args.fit_only:
         print("[calibrate] --fit-only: skipping measurement, refitting "
               "from the cached TPU entries")
     else:
+        print(f"[calibrate] {len(jobs)} measurement jobs "
+              f"(cache: {len(cost._measured)} entries pre-loaded)",
+              flush=True)
         skip = set()
         if args.skip_keys_file and os.path.exists(args.skip_keys_file):
             with open(args.skip_keys_file) as f:

@@ -1,0 +1,29 @@
+"""Single source of truth for the benchmark/report configs.
+
+The calibration (tools/calibrate.py) and the SOAP reports
+(tools/soap_report.py) MUST price and measure the SAME global batch per
+model, or the reports' measured provenance silently stays at zero —
+cache keys encode sub-tensor shapes, so a batch mismatch means no
+measured entry ever matches a priced op.  Both tools default from this
+table; tools/chip_session.sh pins overrides through both consistently.
+
+Reference anchors: AlexNet global batch 64 is the reference default
+(src/runtime/model.cc:1238, BASELINE.json config #1); DLRM/NMT use the
+reports' historical 1024 (64/chip x 16).
+"""
+
+# global batch per model for the 16-chip SOAP-vs-DP comparison
+REPORT_GLOBAL_BATCH = {
+    "alexnet": 64,
+    "dlrm": 1024,
+    "nmt": 1024,
+}
+
+# single-chip bench config (bench.py's AlexNet phase) — also the
+# simulated-vs-measured agreement config
+BENCH_SINGLE_CHIP_BATCH = 256
+
+# A roofline fit from fewer points / op families than this extrapolates
+# beyond its basis; calibrate warns and the reports disclose it.
+THIN_FIT_POINTS = 16
+THIN_FIT_OP_TYPES = 3

@@ -29,7 +29,10 @@ def main(argv: Optional[List[str]] = None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("model", default="alexnet", nargs="?")
     p.add_argument("--devices", type=int, default=16)
-    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="global batch (default: the per-model config in "
+                        "report_configs.py, shared with calibrate so "
+                        "measured cache keys match priced shapes)")
     p.add_argument("--budget", type=int, default=4000)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--compute-dtype", default="bfloat16")
@@ -38,8 +41,14 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--measured-single-chip-ms", type=float, default=None,
                    help="wall-clock ms/step for the single-chip bench "
                         "config (bench.py), for the agreement check")
-    p.add_argument("--single-chip-batch", type=int, default=256)
+    from .report_configs import BENCH_SINGLE_CHIP_BATCH
+
+    p.add_argument("--single-chip-batch", type=int,
+                   default=BENCH_SINGLE_CHIP_BATCH)
     args = p.parse_args(argv)
+    if args.batch_size is None:
+        from .report_configs import REPORT_GLOBAL_BATCH
+        args.batch_size = REPORT_GLOBAL_BATCH.get(args.model, 1024)
 
     # Pure simulation — never init (or hang on) a TPU backend from an
     # offline report run; the axon plugin ignores JAX_PLATFORMS, so set
@@ -160,6 +169,27 @@ def main(argv: Optional[List[str]] = None):
                 "UNFITTED analytic roofline (dataclass defaults — "
                 "machine_v5e.json absent; run tools/calibrate.py on the "
                 "chip)")
+    if fitted:
+        # disclose the fit's basis: a thin basis (few points / one op
+        # family) means the constants extrapolate to unmeasured ops
+        try:
+            from ..simulator.machine import CALIBRATION_PATH
+            from .report_configs import THIN_FIT_OP_TYPES, THIN_FIT_POINTS
+            with open(CALIBRATION_PATH) as f:
+                meta = json.load(f)
+            pts = meta.get("fit_points")
+            fams = meta.get("fit_op_types")
+            if pts:
+                basis = f"fit basis: {pts} measured points"
+                if fams:
+                    basis += f" over {len(fams)} op type(s) ({', '.join(fams)})"
+                if pts < THIN_FIT_POINTS or (fams
+                                             and len(fams) < THIN_FIT_OP_TYPES):
+                    basis += (" — THIN: constants extrapolate to "
+                              "unmeasured op families")
+                roofline += f"; {basis}"
+        except Exception:
+            pass
     lines = [
         f"# SOAP search vs data parallel — {args.model}",
         "",

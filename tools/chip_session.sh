@@ -11,8 +11,15 @@
 set -ex
 cd "$(dirname "$0")/.."
 
+# The SOAP-vs-DP report (stage 4) and the calibration (stage 1) must
+# price/measure the SAME config or the report can never reach measured
+# provenance: one global batch, used by both.  64 = the reference's
+# AlexNet default (BASELINE.json config #1, model.cc:1238).
+AB=${ALEXNET_BATCH:-64}
+
 # 1. measure + fit (supervised worker; wedge-proof, resumes from cache)
-python -m flexflow_tpu.tools.calibrate --max-seconds 2000 --job-timeout 240
+python -m flexflow_tpu.tools.calibrate --max-seconds 2000 \
+    --job-timeout 240 --alexnet-batch "$AB"
 
 # 2. bench: primary line lands immediately; extras in BENCH_EXTRA.json
 # (cleared first — a stale file from an earlier window must never pose
@@ -20,22 +27,29 @@ python -m flexflow_tpu.tools.calibrate --max-seconds 2000 --job-timeout 240
 rm -f BENCH_EXTRA.json
 timeout 1500 python bench.py | tee /tmp/bench_line.json || true
 
-# 3. single-chip agreement: measured ms/step for the bench config
-MEAS_MS=$(python - <<'EOF'
+# 3. single-chip agreement: measured ms/step for the bench config.
+# Both numbers come from BENCH_EXTRA.json — bench.py records the batch
+# the run ACTUALLY used, so the conversion can never desync from a
+# config edit.  `|| true` inside the substitution: under set -e a
+# timeout here must not abort the session before the durability commit.
+MEAS_OUT=$(timeout 60 python - <<'EOF' || true
 import json
 try:
     with open("BENCH_EXTRA.json") as f:
-        sps = json.load(f)["alexnet"]["samples_per_sec_per_chip"]
-    print(f"{256.0 / sps * 1e3:.3f}")
+        a = json.load(f)["alexnet"]
+    print(f"{a['batch'] / a['samples_per_sec_per_chip'] * 1e3:.3f} "
+          f"{a['batch']}")
 except Exception:
     print("")
 EOF
 )
+MEAS_MS=${MEAS_OUT% *}
+MEAS_BATCH=${MEAS_OUT#* }
 
 # 4. SOAP reports with measured provenance (+ agreement when bench landed)
 AGREE=""
 if [ -n "$MEAS_MS" ]; then AGREE="--measured-single-chip-ms $MEAS_MS"; fi
-python -m flexflow_tpu.tools.soap_report alexnet --batch-size 64 \
+python -m flexflow_tpu.tools.soap_report alexnet --batch-size "$AB" \
     --budget 8000 $AGREE --out REPORT_SOAP.md
 python -m flexflow_tpu.tools.soap_report nmt  --out REPORT_SOAP_NMT.md
 python -m flexflow_tpu.tools.soap_report dlrm --out REPORT_SOAP_DLRM.md
@@ -44,12 +58,13 @@ python -m flexflow_tpu.tools.soap_report dlrm --out REPORT_SOAP_DLRM.md
 # agreement line is the simulator's credential — reference: its inputs
 # are measurements by construction, simulator.cc:235-273)
 if [ -n "$MEAS_MS" ]; then
-  python - "$MEAS_MS" <<'EOF'
+  python - "$MEAS_MS" "$MEAS_BATCH" <<'EOF'
 import re
 import sys
 import time
 
 meas = float(sys.argv[1])
+batch = sys.argv[2]
 sim = None
 try:
     with open("REPORT_SOAP.md") as f:
@@ -59,7 +74,8 @@ except Exception:
     pass
 stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
 lines = [f"\n## Single-chip agreement ({stamp})\n\n",
-         f"Bench config (256/chip, 1 device): measured {meas:.2f} ms/step"]
+         f"Bench config ({batch}/chip, 1 device): "
+         f"measured {meas:.2f} ms/step"]
 if sim is not None:
     lines.append(f", simulated {sim:.2f} ms/step — ratio "
                  f"{sim / meas:.2f}. SOAP speedup claims are gated on "
@@ -72,17 +88,25 @@ print("chip_session: agreement bound appended to CALIBRATION.md")
 EOF
 fi
 
-# 5. batch x dtype sweep (writes BENCH_SWEEP.md incrementally)
-if [ -z "$SKIP_SWEEP" ]; then
-  timeout 1800 python bench.py --sweep || true
-fi
-
-# 6. XLA profiler trace of the AlexNet step (the input to the measured
-# optimization work: kernel timeline, HBM traffic, fusion boundaries).
-# Cleared first — a stale trace from an earlier window must not pose as
-# this build's kernel timeline.
+# A stale trace from an earlier window must never pose as this build's
+# kernel timeline — clear it whether or not this window profiles.
 rm -rf /tmp/flexflow_tpu_trace
-timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
+
+# 5+6 run only when the bench actually landed: hammering a wedged chip
+# with a 30-min sweep + profile just delays the watcher's next probe —
+# re-arming fast is what converts the next window.
+if [ -n "$MEAS_MS" ]; then
+  # 5. batch x dtype sweep (writes BENCH_SWEEP.md incrementally)
+  if [ -z "${SKIP_SWEEP:-}" ]; then
+    timeout 1800 python bench.py --sweep || true
+  fi
+
+  # 6. XLA profiler trace of the AlexNet step (the input to the measured
+  # optimization work: kernel timeline, HBM traffic, fusion boundaries).
+  timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
+else
+  echo "chip_session: bench did not land — skipping sweep/profile to re-arm fast"
+fi
 
 # 7. commit the measurement artifacts so a window that converts while
 # nobody is watching still lands durably (data files only — no source).
