@@ -27,3 +27,8 @@ BENCH_SINGLE_CHIP_BATCH = 256
 # beyond its basis; calibrate warns and the reports disclose it.
 THIN_FIT_POINTS = 16
 THIN_FIT_OP_TYPES = 3
+
+# tpu_watch stops converting windows once the measured cache holds this
+# many TPU entries (the default ~654-job space is majority-measured);
+# shrink alongside --models if the job space is narrowed.
+CALIBRATION_TARGET_ENTRIES = 350

@@ -1,37 +1,37 @@
 #!/usr/bin/env bash
-# One healthy-chip window, end to end: calibrate the cost model on the
-# real TPU, refit the roofline, regenerate the three SOAP reports with
-# measured provenance and the single-chip agreement check, then take the
-# bench numbers + sweep.  Every stage is individually time-bounded and
-# resumable (calibration persists per-job; bench prints its primary line
-# first), so a tunnel wedge mid-window keeps everything landed so far.
+# One healthy-chip window, end to end, ordered by artifact value: the
+# bench number FIRST (the deliverable four rounds of wedged tunnels have
+# missed — its primary line lands ~8 min in), then on-chip calibration,
+# then the SOAP reports with measured provenance and the single-chip
+# agreement bound, then the profiler trace and the sweep.  Every stage
+# is individually time-bounded and resumable (calibration persists
+# per-job; bench prints its primary line first), so a tunnel wedge
+# mid-window keeps everything landed so far.
 #
-#   bash tools/chip_session.sh            # full window (~45 min healthy)
+#   bash tools/chip_session.sh            # full window (~60 min healthy)
 #   SKIP_SWEEP=1 bash tools/chip_session.sh
 set -ex
 cd "$(dirname "$0")/.."
 
-# The SOAP-vs-DP report (stage 4) and the calibration (stage 1) must
-# price/measure the SAME config or the report can never reach measured
-# provenance: one global batch, used by both.  64 = the reference's
-# AlexNet default (BASELINE.json config #1, model.cc:1238).
+# The SOAP-vs-DP report and the calibration must price/measure the SAME
+# config or the report can never reach measured provenance: one global
+# batch, used by both (default: report_configs.py's shared table —
+# 64 = the reference's AlexNet default, model.cc:1238).
 AB=${ALEXNET_BATCH:-64}
 
-# 1. measure + fit (supervised worker; wedge-proof, resumes from cache)
-python -m flexflow_tpu.tools.calibrate --max-seconds 2000 \
-    --job-timeout 240 --alexnet-batch "$AB"
-
-# 2. bench: primary line lands immediately; extras in BENCH_EXTRA.json
-# (cleared first — a stale file from an earlier window must never pose
-# as this run's measurement in the agreement check below)
+# 1. bench: the primary JSON line lands the moment AlexNet finishes;
+# extras in BENCH_EXTRA.json (cleared first — a stale file from an
+# earlier window must never pose as this run's measurement in the
+# agreement check below)
 rm -f BENCH_EXTRA.json
 timeout 1500 python bench.py | tee /tmp/bench_line.json || true
 
-# 3. single-chip agreement: measured ms/step for the bench config.
-# Both numbers come from BENCH_EXTRA.json — bench.py records the batch
-# the run ACTUALLY used, so the conversion can never desync from a
-# config edit.  `|| true` inside the substitution: under set -e a
-# timeout here must not abort the session before the durability commit.
+# 2. single-chip agreement inputs: measured ms/step for the bench
+# config.  Both numbers come from BENCH_EXTRA.json — bench.py records
+# the batch the run ACTUALLY used, so the conversion can never desync
+# from a config edit.  `|| true` inside the substitution: under set -e
+# a timeout here must not abort the session before the durability
+# commit.
 MEAS_OUT=$(timeout 60 python - <<'EOF' || true
 import json
 try:
@@ -46,9 +46,37 @@ EOF
 MEAS_MS=${MEAS_OUT% *}
 MEAS_BATCH=${MEAS_OUT#* }
 
-# 4. SOAP reports with measured provenance (+ agreement when bench landed)
+# Distinguish "chip wedged" (watchdog kill / silence) from "bench has a
+# software bug on a healthy chip" (a real Python error in the primary
+# line): a deterministic bench bug must not disable calibration for
+# every remaining window.
+WEDGED=1
+if [ -n "$MEAS_MS" ]; then
+  WEDGED=0
+elif grep -q '"error"' /tmp/bench_line.json 2>/dev/null \
+    && ! grep -q 'watchdog' /tmp/bench_line.json 2>/dev/null; then
+  echo "chip_session: bench failed in SOFTWARE (see /tmp/bench_line.json); chip presumed healthy"
+  WEDGED=0
+fi
+
+# 3. measure + fit (supervised worker; wedge-proof, resumes from cache;
+# job list is ordered highest-value-first for short windows).  Gated on
+# the chip being alive: burning the calibrate supervisor's restart
+# budget against a wedge only delays the watcher's next probe.
+if [ "$WEDGED" = 0 ]; then
+  python -m flexflow_tpu.tools.calibrate --max-seconds 2000 \
+      --job-timeout 240 --alexnet-batch "$AB" || true
+fi
+
+# 4. SOAP reports with measured provenance (+ agreement when bench
+# landed).  CPU-side simulation — runs whether or not the chip held, so
+# a partial window still refreshes the reports against the latest fit.
 AGREE=""
-if [ -n "$MEAS_MS" ]; then AGREE="--measured-single-chip-ms $MEAS_MS"; fi
+if [ -n "$MEAS_MS" ]; then
+  # pin the simulated leg to the batch the bench run ACTUALLY used —
+  # config drift between the two stages must not skew the ratio
+  AGREE="--measured-single-chip-ms $MEAS_MS --single-chip-batch $MEAS_BATCH"
+fi
 python -m flexflow_tpu.tools.soap_report alexnet --batch-size "$AB" \
     --budget 8000 $AGREE --out REPORT_SOAP.md
 python -m flexflow_tpu.tools.soap_report nmt  --out REPORT_SOAP_NMT.md
@@ -93,19 +121,20 @@ fi
 rm -rf /tmp/flexflow_tpu_trace
 
 # 5+6 run only when the bench actually landed: hammering a wedged chip
-# with a 30-min sweep + profile just delays the watcher's next probe —
+# with a 30-min profile + sweep just delays the watcher's next probe —
 # re-arming fast is what converts the next window.
 if [ -n "$MEAS_MS" ]; then
-  # 5. batch x dtype sweep (writes BENCH_SWEEP.md incrementally)
+  # 5. XLA profiler trace of the AlexNet step, before the sweep: it is
+  # the input to the measured-optimization work (kernel timeline, HBM
+  # traffic, fusion boundaries) and a fraction of the sweep's cost.
+  timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
+
+  # 6. batch x dtype sweep (writes BENCH_SWEEP.md incrementally)
   if [ -z "${SKIP_SWEEP:-}" ]; then
     timeout 1800 python bench.py --sweep || true
   fi
-
-  # 6. XLA profiler trace of the AlexNet step (the input to the measured
-  # optimization work: kernel timeline, HBM traffic, fusion boundaries).
-  timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
 else
-  echo "chip_session: bench did not land — skipping sweep/profile to re-arm fast"
+  echo "chip_session: bench did not land — skipping profile/sweep to re-arm fast"
 fi
 
 # 7. commit the measurement artifacts so a window that converts while
