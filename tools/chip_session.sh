@@ -129,6 +129,14 @@ if [ -n "$MEAS_MS" ]; then
   # traffic, fusion boundaries) and a fraction of the sweep's cost.
   timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
 
+  # 5b. per-op profile table (committed artifact; the reference's
+  # --profiling per-op printouts, conv_2d.cu:448-473).  Cleared first:
+  # a file left by an earlier window that died before its commit must
+  # not be committed under THIS window's provenance.
+  rm -f PROFILE_v5e.md
+  timeout 600 python -m flexflow_tpu.tools.profile_report alexnet \
+      --batch-size "$MEAS_BATCH" --out PROFILE_v5e.md || true
+
   # 6. batch x dtype sweep (writes BENCH_SWEEP.md incrementally)
   if [ -z "${SKIP_SWEEP:-}" ]; then
     timeout 1800 python bench.py --sweep || true
@@ -143,8 +151,8 @@ fi
 # must never be swept into a commit asserting "data files only", and a
 # missing optional artifact (e.g. SKIP_SWEEP) must not abort the commit.
 ARTS=""
-for f in BENCH_EXTRA.json BENCH_SWEEP.md CALIBRATION.md REPORT_SOAP.md \
-         REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
+for f in BENCH_EXTRA.json BENCH_SWEEP.md PROFILE_v5e.md CALIBRATION.md \
+         REPORT_SOAP.md REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
          flexflow_tpu/simulator/measured_v5e.json \
          flexflow_tpu/simulator/machine_v5e.json; do
   [ -f "$f" ] && ARTS="$ARTS $f"
