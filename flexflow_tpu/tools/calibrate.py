@@ -364,6 +364,67 @@ def fit_machine(recs: List[Dict], machine) -> Dict[str, float]:
     return fit
 
 
+def build_job_list(cost, devices: int, alexnet_batch: int, bench_batch: int,
+                   models_csv: str, report_batch: Optional[int],
+                   inception: bool, inception_jobs: int, fit_only: bool):
+    """Measurement jobs ordered for short wedge-prone windows, plus the
+    (models, nds) lists the roofline fit enumerates records over.
+
+    The tunnel wedges without warning, so a "window" is often only a
+    few healthy minutes: single-chip bench shapes lead (they are the
+    agreement check AND the fit's anchor points), then every report
+    model's SOAP candidate space + the Inception spread runs
+    cheapest-analytic-first — small shapes compile and run fastest,
+    landing the most fit points per minute, and the fitted roofline
+    covers whatever a short window leaves unmeasured.  ``fit_only``
+    skips job enumeration but still builds the model list (including
+    the legacy batch-1024 AlexNet space, so the first converted
+    window's cache entries keep feeding every refit)."""
+    from .report_configs import REPORT_GLOBAL_BATCH
+
+    models, nds = [], []
+    mb = _model("alexnet", bench_batch, 1)
+    models.append(mb)
+    nds.append(1)
+    jobs = [] if fit_only else candidate_jobs(mb, 1, cost, full=False)
+    rest = []
+    wanted = [s.strip() for s in models_csv.split(",") if s.strip()]
+    for name in wanted:
+        if name == "alexnet":
+            bs = alexnet_batch
+        elif report_batch is not None:
+            bs = report_batch
+        else:
+            bs = REPORT_GLOBAL_BATCH.get(name, 1024)
+        mr = _model(name, bs, devices)
+        models.append(mr)
+        nds.append(devices)
+        if not fit_only:
+            rest += candidate_jobs(mr, devices, cost, full=True)
+    if "alexnet" in wanted and alexnet_batch != 1024:
+        # Fit-records only (never measured): the first converted window
+        # (round 5) cached batch-1024 alexnet shapes; enumerate that
+        # space too so those points keep feeding every future refit.
+        models.append(_model("alexnet", 1024, devices))
+        nds.append(devices)
+    if inception:
+        mi = _model("inception", bench_batch, devices)
+        models.append(mi)
+        nds.append(devices)
+        if not fit_only:
+            ijobs = candidate_jobs(mi, devices, cost, full=False)
+            if inception_jobs and len(ijobs) > inception_jobs:
+                # Even subsample: Inception entries feed the roofline fit
+                # and spot-checks, not the AlexNet SOAP search — a spread
+                # of its 94 conv shapes is enough (the fitted analytic
+                # covers the rest).
+                stride = max(1, len(ijobs) // inception_jobs)
+                ijobs = ijobs[::stride][:inception_jobs]
+            rest += ijobs
+    rest.sort(key=lambda j: cost._analytic(j[0], j[1], j[2]))
+    return jobs + rest, models, nds
+
+
 def main(argv: Optional[List[str]] = None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--devices", type=int, default=16,
@@ -475,54 +536,11 @@ def main(argv: Optional[List[str]] = None):
                         measured_cache_path=out,
                         target_platform="tpu" if args.fit_only else platform)
 
-    models, nds = [], []
-    # The tunnel wedges without warning, so a "window" is often only a
-    # few healthy minutes: order jobs so the highest-value entries land
-    # first.  Single-chip bench shapes lead (they are the agreement
-    # check AND the fit's anchor points), then the SOAP space + the
-    # Inception spread cheapest-analytic-first — small shapes compile
-    # and run fastest, landing the most fit points per minute, and the
-    # fitted roofline covers whatever a short window leaves unmeasured.
-    mb = _model("alexnet", args.bench_batch, 1)
-    models.append(mb)
-    nds.append(1)
-    jobs = [] if args.fit_only else candidate_jobs(mb, 1, cost, full=False)
-    rest = []
-    wanted = [s.strip() for s in args.models.split(",") if s.strip()]
-    for name in wanted:
-        if name == "alexnet":
-            bs = args.alexnet_batch
-        elif args.report_batch is not None:
-            bs = args.report_batch
-        else:
-            bs = REPORT_GLOBAL_BATCH.get(name, 1024)
-        mr = _model(name, bs, args.devices)
-        models.append(mr)
-        nds.append(args.devices)
-        if not args.fit_only:
-            rest += candidate_jobs(mr, args.devices, cost, full=True)
-    if "alexnet" in wanted and args.alexnet_batch != 1024:
-        # Fit-records only (never measured): the first converted window
-        # (round 5) cached batch-1024 alexnet shapes; enumerate that
-        # space too so those points keep feeding every future refit.
-        models.append(_model("alexnet", 1024, args.devices))
-        nds.append(args.devices)
-    if args.inception:
-        mi = _model("inception", args.bench_batch, args.devices)
-        models.append(mi)
-        nds.append(args.devices)
-        if not args.fit_only:
-            ijobs = candidate_jobs(mi, args.devices, cost, full=False)
-            if args.inception_jobs and len(ijobs) > args.inception_jobs:
-                # Even subsample: Inception entries feed the roofline fit
-                # and spot-checks, not the AlexNet SOAP search — a spread
-                # of its 94 conv shapes is enough (the fitted analytic
-                # covers the rest).
-                stride = max(1, len(ijobs) // args.inception_jobs)
-                ijobs = ijobs[::stride][:args.inception_jobs]
-            rest += ijobs
-    rest.sort(key=lambda j: cost._analytic(j[0], j[1], j[2]))
-    jobs += rest
+    jobs, models, nds = build_job_list(
+        cost, devices=args.devices, alexnet_batch=args.alexnet_batch,
+        bench_batch=args.bench_batch, models_csv=args.models,
+        report_batch=args.report_batch, inception=args.inception,
+        inception_jobs=args.inception_jobs, fit_only=args.fit_only)
 
     if args.fit_only:
         print("[calibrate] --fit-only: skipping measurement, refitting "

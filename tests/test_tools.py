@@ -131,3 +131,54 @@ def test_calibrate_host_transfer_measure_and_fit(tmp_path, devices):
     with open(cache) as f:
         data = _json.load(f)
     assert data["host_xfer:1048576"]["platform"] == "cpu"
+
+
+def test_calibrate_job_list_order(devices, tmp_path):
+    """Short-window job ordering contract: the single-chip bench shapes
+    (agreement-check anchors) lead, the remaining candidate spaces run
+    cheapest-analytic-first, and the report models' spaces are present
+    so measured provenance is reachable for every REPORT_SOAP_*."""
+    from flexflow_tpu.simulator.cost_model import CostModel
+    from flexflow_tpu.simulator.machine import TPUMachineModel
+    from flexflow_tpu.tools.calibrate import (_model, build_job_list,
+                                              candidate_jobs)
+
+    # an isolated (empty) measured cache: the packaged measured_v5e.json
+    # would dedupe any matching candidate keys out of the job list and
+    # make this test flap on data-only commits
+    empty_cache = str(tmp_path / "empty_cache.json")
+    cost = CostModel(TPUMachineModel(num_devices=16),
+                     cache_path=empty_cache,
+                     measured_cache_path=empty_cache)
+    jobs, models, nds = build_job_list(
+        cost, devices=16, alexnet_batch=64, bench_batch=256,
+        models_csv="alexnet,dlrm,nmt", report_batch=None,
+        inception=True, inception_jobs=8, fit_only=False)
+
+    # bench anchors first: the exact single-chip job set, in order
+    bench_keys = [j[3] for j in
+                  candidate_jobs(_model("alexnet", 256, 1), 1, cost,
+                                 full=False)]
+    n_bench = len(bench_keys)
+    assert n_bench >= 4, "single-chip bench shapes must exist"
+    assert [j[3] for j in jobs[:n_bench]] == bench_keys, \
+        "single-chip bench shapes must lead the list"
+
+    # the rest is monotone in analytic cost
+    costs = [cost._analytic(op, pc, which)
+             for op, pc, which, key in jobs[n_bench:]]
+    assert costs == sorted(costs)
+
+    # every report model's space is enumerated (keys carry the op type)
+    keys = " ".join(j[3] for j in jobs)
+    assert "LSTM" in keys and "Embedding" in keys  # nmt + dlrm present
+
+    # fit_only builds no jobs but keeps the fit-record models, including
+    # the legacy batch-1024 AlexNet space of the first converted window
+    jobs2, models2, nds2 = build_job_list(
+        cost, devices=16, alexnet_batch=64, bench_batch=256,
+        models_csv="alexnet", report_batch=None,
+        inception=False, inception_jobs=0, fit_only=True)
+    assert jobs2 == []
+    assert any(any(op.output.dims[0] == 1024 for op in m.ops)
+               for m in models2), "legacy 1024 space must stay fit-eligible"
