@@ -132,10 +132,13 @@ class CostModel:
                     for wi in range(len(op.weights)))
         out_vol = int(np.prod(sub))
         bytes_moved = self._dtype_bytes * (in_vol + w_vol + out_vol)
-        t = max(flops / (m.peak_flops * m.mxu_efficiency),
+        fam = type(op).__name__
+        eff = m.op_efficiency.get(fam, m.mxu_efficiency)
+        t = max(flops / (m.peak_flops * eff),
                 bytes_moved / m.hbm_bandwidth) + m.kernel_launch_overhead
         if which == "backward":
-            t *= m.backward_multiplier  # dgrad + wgrad (fitted; default 2×)
+            # dgrad + wgrad (fitted per family where measured; default 2×)
+            t *= m.op_backward_multiplier.get(fam, m.backward_multiplier)
         return float(t)
 
     # -- real measurement --------------------------------------------------

@@ -46,6 +46,15 @@ class TPUMachineModel:
     # measured host_xfer ladder alongside pcie_bandwidth).
     host_xfer_latency: float = 0.0
     hbm_capacity: float = 16e9        # bytes per chip (v5e 16 GB)
+    # Per-op-family roofline overrides fitted by tools/calibrate.py once
+    # enough measured families land (e.g. {"Conv2D": 0.5, "LSTM": 0.3});
+    # families absent here use the global constants above.  One global
+    # MXU efficiency cannot describe conv im2col, LSTM scan steps, and
+    # gather-bound embeddings at once — the per-family fit is what makes
+    # the simulated-vs-measured agreement bound tight.
+    op_efficiency: Dict[str, float] = dataclasses.field(default_factory=dict)
+    op_backward_multiplier: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @classmethod
     def calibrated(cls, **kw) -> "TPUMachineModel":

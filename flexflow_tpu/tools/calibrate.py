@@ -341,12 +341,52 @@ def fit_machine(recs: List[Dict], machine) -> Dict[str, float]:
     ratios = [r["t_bwd"] / r["t_fwd"] for r in recs
               if r["t_bwd"] and r["t_fwd"] > 0]
     bwd_mult = float(np.median(ratios)) if ratios else 2.0
-    op_types = sorted({r.get("op", "?") for r in recs})
+    # Per-family refinement, holding the global memory constants: one
+    # global MXU efficiency cannot describe conv im2col, LSTM scan
+    # steps, and gather-bound ops at once.  Families with too few points
+    # keep the global constants.
+    op_eff: Dict[str, float] = {}
+    op_bwd: Dict[str, float] = {}
+    fams: Dict[str, List[Dict]] = {}
+    for r in recs:
+        fams.setdefault(r.get("op", "?"), []).append(r)
+    for fam, rs in fams.items():
+        if len(rs) < 3:
+            continue
+        ff = np.array([r["flops"] for r in rs])
+        fb = np.array([r["bytes"] for r in rs])
+        fm = np.array([r["t_fwd"] for r in rs])
+
+        def _fam_err(e):
+            pred = np.maximum(ff / (machine.peak_flops * e),
+                              fb / (machine.hbm_bandwidth * hbm_eff)) + ovh
+            return float(np.mean(np.log(pred / fm) ** 2))
+
+        # Seeded with the GLOBAL efficiency's error: a family whose
+        # shapes are all memory-bound has a flat error surface, and a
+        # strict grid argmin would record the grid floor (0.05) — such
+        # families must keep the global constant instead.
+        fbest = (eff, _fam_err(eff))
+        for e in np.arange(0.05, 1.001, 0.01):
+            e_err = _fam_err(e)
+            if e_err < fbest[1]:
+                fbest = (float(e), e_err)
+        op_eff[fam] = fbest[0]
+        fr = [r["t_bwd"] / r["t_fwd"] for r in rs
+              if r["t_bwd"] and r["t_fwd"] > 0]
+        # same minimum-sample bar as the efficiency fit: one noisy
+        # backward ratio must not override the robust global median
+        if len(fr) >= 3:
+            op_bwd[fam] = float(np.median(fr))
+
+    op_types = sorted(fams)
     fit = {
         "mxu_efficiency": eff,
         "hbm_bandwidth": machine.hbm_bandwidth * hbm_eff,
         "kernel_launch_overhead": ovh,
         "backward_multiplier": bwd_mult,
+        "op_efficiency": op_eff,
+        "op_backward_multiplier": op_bwd,
         "fit_log_rmse": math.sqrt(err),
         "fit_points": len(recs),
         "fit_op_types": op_types,
@@ -593,6 +633,12 @@ def main(argv: Optional[List[str]] = None):
                     prev = json.load(f)
             except Exception:
                 prev = {}
+        # per-key merge for the per-family dicts: a refit whose record
+        # enumeration no longer covers an earlier family must not erase
+        # that family's fitted constants
+        for dk in ("op_efficiency", "op_backward_multiplier"):
+            if dk in prev or dk in merged:
+                merged[dk] = {**prev.get(dk, {}), **merged.get(dk, {})}
         merged = {**prev, **merged}
         with open(fit_out, "w") as f:
             json.dump(merged, f, indent=1)

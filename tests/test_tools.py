@@ -182,3 +182,64 @@ def test_calibrate_job_list_order(devices, tmp_path):
     assert jobs2 == []
     assert any(any(op.output.dims[0] == 1024 for op in m.ops)
                for m in models2), "legacy 1024 space must stay fit-eligible"
+
+
+def test_fit_machine_per_family(devices):
+    """The roofline fit emits per-op-family efficiency / backward
+    multipliers (>=3 points per family), and the analytic cost model
+    consumes them in place of the global constants."""
+    import numpy as np
+
+    from flexflow_tpu.simulator.cost_model import CostModel
+    from flexflow_tpu.simulator.machine import TPUMachineModel
+    from flexflow_tpu.tools.calibrate import fit_machine
+
+    mm = TPUMachineModel(num_devices=1)
+    # synthetic measured records: Conv2D runs at 50% of peak with 4x
+    # backward, Dense at 25% with 2x — flops-dominated so the family
+    # efficiency is identifiable
+    recs = []
+    for fam, eff, bwd in (("Conv2D", 0.5, 4.0), ("Dense", 0.25, 2.0)):
+        for i, gf in enumerate((1e12, 2e12, 4e12)):
+            t = gf / (mm.peak_flops * eff)
+            recs.append({"key": f"{fam}:{i}", "op": fam, "flops": gf,
+                         "bytes": 1e6, "t_fwd": t, "t_bwd": t * bwd})
+    # plus a memory-bound family: its efficiency is unidentifiable (the
+    # flops term never binds), so it must KEEP the global constant
+    # rather than the grid floor
+    for i in range(3):
+        b = 1e9 * (i + 1)
+        recs.append({"key": f"Softmax:{i}", "op": "Softmax", "flops": 1e3,
+                     "bytes": b, "t_fwd": b / (mm.hbm_bandwidth * 0.8),
+                     "t_bwd": None})
+    fit = fit_machine(recs, mm)
+    assert abs(fit["op_efficiency"]["Conv2D"] - 0.5) < 0.02
+    assert abs(fit["op_efficiency"]["Dense"] - 0.25) < 0.02
+    assert fit["op_efficiency"]["Softmax"] == fit["mxu_efficiency"]
+    assert abs(fit["op_backward_multiplier"]["Conv2D"] - 4.0) < 1e-6
+    assert abs(fit["op_backward_multiplier"]["Dense"] - 2.0) < 1e-6
+    assert "Softmax" not in fit["op_backward_multiplier"]  # no bwd samples
+
+    # the analytic model consumes the per-family overrides
+    import flexflow_tpu as ff
+    # MXU-bound shape: the flops term must dominate the roofline max()
+    # or the efficiency override is invisible
+    m = ff.FFModel(ff.FFConfig(batch_size=2048))
+    t = m.create_tensor((2048, 2048), "float")
+    d = m.dense(t, 2048, name="fc")
+    m.compile(ff.SGDOptimizer(m, lr=0.01),
+              ff.LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+    op = next(o for o in m.ops if o.name == "fc")
+    pc = op.pc
+
+    base = CostModel(TPUMachineModel(num_devices=1), cache_path="")
+    # the family key is the op CLASS name ("Linear" — the graph-level
+    # type string is "Dense", but calibrate records type(op).__name__)
+    tuned_mm = TPUMachineModel(num_devices=1,
+                               op_efficiency={"Linear": 0.1},
+                               op_backward_multiplier={"Linear": 8.0})
+    tuned = CostModel(tuned_mm, cache_path="")
+    # lower efficiency -> slower fwd; family bwd multiplier applies
+    assert tuned._analytic(op, pc, "forward") > base._analytic(op, pc, "forward")
+    r = tuned._analytic(op, pc, "backward") / tuned._analytic(op, pc, "forward")
+    assert abs(r - 8.0) < 1e-6
