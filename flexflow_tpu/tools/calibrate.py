@@ -371,7 +371,12 @@ def fit_machine(recs: List[Dict], machine) -> Dict[str, float]:
             e_err = _fam_err(e)
             if e_err < fbest[1]:
                 fbest = (float(e), e_err)
-        op_eff[fam] = fbest[0]
+        # Only families the grid actually identified get an entry: a
+        # kept-global seed written out would pin the family to a STALE
+        # snapshot of the global after later refits shift it (the
+        # never-erase merge preserves old entries deliberately).
+        if fbest[0] != eff:
+            op_eff[fam] = fbest[0]
         fr = [r["t_bwd"] / r["t_fwd"] for r in rs
               if r["t_bwd"] and r["t_fwd"] > 0]
         # same minimum-sample bar as the efficiency fit: one noisy

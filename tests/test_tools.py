@@ -215,7 +215,9 @@ def test_fit_machine_per_family(devices):
     fit = fit_machine(recs, mm)
     assert abs(fit["op_efficiency"]["Conv2D"] - 0.5) < 0.02
     assert abs(fit["op_efficiency"]["Dense"] - 0.25) < 0.02
-    assert fit["op_efficiency"]["Softmax"] == fit["mxu_efficiency"]
+    # unidentifiable family: NO entry (falls through to the live global
+    # rather than pinning a stale snapshot of today's global)
+    assert "Softmax" not in fit["op_efficiency"]
     assert abs(fit["op_backward_multiplier"]["Conv2D"] - 4.0) < 1e-6
     assert abs(fit["op_backward_multiplier"]["Dense"] - 2.0) < 1e-6
     assert "Softmax" not in fit["op_backward_multiplier"]  # no bwd samples

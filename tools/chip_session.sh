@@ -81,6 +81,9 @@ python -m flexflow_tpu.tools.soap_report alexnet --batch-size "$AB" \
     --budget 8000 $AGREE --out REPORT_SOAP.md
 python -m flexflow_tpu.tools.soap_report nmt  --out REPORT_SOAP_NMT.md
 python -m flexflow_tpu.tools.soap_report dlrm --out REPORT_SOAP_DLRM.md
+# BASELINE config #5: ResNet-50, searched strategy, v5e-64 multi-host
+python -m flexflow_tpu.tools.soap_report resnet --devices 64 \
+    --out REPORT_SOAP_RESNET.md
 
 # 4b. state the simulator's error bound in CALIBRATION.md (the measured
 # agreement line is the simulator's credential — reference: its inputs
@@ -153,6 +156,7 @@ fi
 ARTS=""
 for f in BENCH_EXTRA.json BENCH_SWEEP.md PROFILE_v5e.md CALIBRATION.md \
          REPORT_SOAP.md REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
+         REPORT_SOAP_RESNET.md \
          flexflow_tpu/simulator/measured_v5e.json \
          flexflow_tpu/simulator/machine_v5e.json; do
   [ -f "$f" ] && ARTS="$ARTS $f"
