@@ -15,14 +15,17 @@ reports' historical 1024 (64/chip x 16).
 # global batch per model for the SOAP-vs-DP comparison (alexnet/dlrm/
 # nmt at 16 chips; resnet at 64 chips — BASELINE.json config #5's
 # "ResNet-50 with simulator-searched strategy on v5e-64 multi-host").
-# resnet is SIMULATION-ONLY: calibrate's default job space does not
-# enumerate its 64-device sub-shapes, so its report is always priced by
-# the fitted roofline (each report's provenance line states this).
+# resnet and inception (8 chips, the reference's bs-256 config) are
+# SIMULATION-ONLY at report scale: calibrate's default job space does
+# not enumerate their multi-device sub-shapes, so those reports are
+# always priced by the fitted roofline (each report's provenance line
+# states this).
 REPORT_GLOBAL_BATCH = {
     "alexnet": 64,
     "dlrm": 1024,
     "nmt": 1024,
     "resnet": 2048,
+    "inception": 256,
 }
 
 # single-chip bench config (bench.py's AlexNet phase) — also the

@@ -84,6 +84,9 @@ python -m flexflow_tpu.tools.soap_report dlrm --out REPORT_SOAP_DLRM.md
 # BASELINE config #5: ResNet-50, searched strategy, v5e-64 multi-host
 python -m flexflow_tpu.tools.soap_report resnet --devices 64 \
     --out REPORT_SOAP_RESNET.md
+# BASELINE config #2's shape: InceptionV3 bs-256, 8 chips
+python -m flexflow_tpu.tools.soap_report inception --devices 8 \
+    --out REPORT_SOAP_INCEPTION.md
 
 # 4b. state the simulator's error bound in CALIBRATION.md (the measured
 # agreement line is the simulator's credential — reference: its inputs
@@ -156,7 +159,7 @@ fi
 ARTS=""
 for f in BENCH_EXTRA.json BENCH_SWEEP.md PROFILE_v5e.md CALIBRATION.md \
          REPORT_SOAP.md REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
-         REPORT_SOAP_RESNET.md \
+         REPORT_SOAP_RESNET.md REPORT_SOAP_INCEPTION.md \
          flexflow_tpu/simulator/measured_v5e.json \
          flexflow_tpu/simulator/machine_v5e.json; do
   [ -f "$f" ] && ARTS="$ARTS $f"
