@@ -35,10 +35,12 @@ while :; do
     exit 2
   fi
   n=$((n + 1))
-  # 150 s: a healthy chip answers the tiny matmul (incl. tunnel backend
-  # init) well inside 2 min, while a wedged probe otherwise burns its
-  # whole timeout and halves the polling cadence.
-  if timeout 150 python -c "$PROBE" >/tmp/tpu_probe.out 2>/tmp/tpu_probe.err \
+  # 90 s: a healthy chip answers the tiny matmul (tunnel backend init
+  # ~10-40 s + one sync) comfortably inside this, while a wedged probe
+  # burns its whole timeout — the timeout sets the polling cadence, and
+  # cadence is what catches short windows.  (The doctor's accelerator
+  # probe uses the same 90 s bound.)
+  if timeout 90 python -c "$PROBE" >/tmp/tpu_probe.out 2>/tmp/tpu_probe.err \
       && grep -q TPU_OK /tmp/tpu_probe.out; then
     echo "tpu_watch: TPU healthy at $(date -u +%FT%TZ) (probe #$n) — firing chip_session"
     touch /tmp/TPU_ALIVE
