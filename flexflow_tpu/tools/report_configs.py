@@ -41,3 +41,14 @@ THIN_FIT_OP_TYPES = 3
 # many TPU entries (the default ~654-job space is majority-measured);
 # shrink alongside --models if the job space is narrowed.
 CALIBRATION_TARGET_ENTRIES = 350
+
+# Annealing budget per model for the SOAP reports.  The per-iteration
+# cost differs by orders of magnitude across models (alexnet's space
+# anneals natively in seconds; the larger graphs pay more per step), so
+# one global budget either under-converges the cheap searches or makes
+# the expensive ones take an hour.  Restarts (independent seeds, best
+# kept) apply on top — basin variance at fixed budget measured ~4.4 to
+# 5.2x on alexnet@16.
+SEARCH_BUDGET = {"alexnet": 40000}
+SEARCH_BUDGET_DEFAULT = 4000
+SEARCH_RESTARTS = 4

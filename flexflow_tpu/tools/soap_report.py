@@ -33,8 +33,14 @@ def main(argv: Optional[List[str]] = None):
                    help="global batch (default: the per-model config in "
                         "report_configs.py, shared with calibrate so "
                         "measured cache keys match priced shapes)")
-    p.add_argument("--budget", type=int, default=4000)
+    p.add_argument("--budget", type=int, default=None,
+                   help="annealing iterations per restart (default: the "
+                        "per-model entry in report_configs.py)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--restarts", type=int, default=None,
+                   help="independent annealing restarts (seeds seed.."
+                        "seed+N-1); the best plan is kept (default: "
+                        "report_configs.SEARCH_RESTARTS)")
     p.add_argument("--compute-dtype", default="bfloat16")
     p.add_argument("--export", default=None)
     p.add_argument("--out", default="REPORT_SOAP.md")
@@ -46,9 +52,15 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--single-chip-batch", type=int,
                    default=BENCH_SINGLE_CHIP_BATCH)
     args = p.parse_args(argv)
+    from .report_configs import (REPORT_GLOBAL_BATCH, SEARCH_BUDGET,
+                                 SEARCH_BUDGET_DEFAULT, SEARCH_RESTARTS)
     if args.batch_size is None:
-        from .report_configs import REPORT_GLOBAL_BATCH
         args.batch_size = REPORT_GLOBAL_BATCH.get(args.model, 1024)
+    if args.budget is None:
+        args.budget = SEARCH_BUDGET.get(args.model, SEARCH_BUDGET_DEFAULT)
+    if args.restarts is None:
+        args.restarts = SEARCH_RESTARTS
+    args.restarts = max(1, args.restarts)
 
     # Pure simulation — never init (or hang on) a TPU backend from an
     # offline report run; the axon plugin ignores JAX_PLATFORMS, so set
@@ -78,17 +90,31 @@ def main(argv: Optional[List[str]] = None):
           for op in model.ops}
     dp_rt = sim.simulate_runtime(model, dp)
 
+    # Multi-restart annealing: independent seeds explore different
+    # basins and the variance across them is large (measured ~4.4-5.2x
+    # on alexnet@16 at the same budget); keep the best plan.  The
+    # native engine makes restarts nearly free (~seconds each).
     best = None
-    r = native_mcmc_search(model, budget=args.budget, machine_model=mm,
-                           seed=args.seed, verbose=False)
+    best_rt = float("inf")
     engine = "native (C++ annealing)"
-    if r is not None:
-        best = r[0]
-    if best is None:
-        engine = "python MCMC"
-        best = mcmc_search(model, budget=args.budget, machine_model=mm,
-                           measure=False, seed=args.seed, verbose=False)
-    best_rt = sim.simulate_runtime(model, best)
+    for rs in range(args.restarts):
+        cand = None
+        r = native_mcmc_search(model, budget=args.budget, machine_model=mm,
+                               seed=args.seed + rs, verbose=False)
+        if r is not None:
+            cand = r[0]
+        if cand is None:
+            # The python engine pays orders of magnitude more per step —
+            # a native-sized budget would turn the fallback into an
+            # hour-long run; cap it (and say so in the report).
+            py_budget = min(args.budget, SEARCH_BUDGET_DEFAULT)
+            engine = f"python MCMC (budget capped at {py_budget})"
+            cand = mcmc_search(model, budget=py_budget, machine_model=mm,
+                               measure=False, seed=args.seed + rs,
+                               verbose=False)
+        cand_rt = sim.simulate_runtime(model, cand)
+        if cand_rt < best_rt:
+            best, best_rt = cand, cand_rt
     speedup = dp_rt / best_rt if best_rt > 0 else float("inf")
 
     # the OTHER searched space: GPipe stage assignment
@@ -204,7 +230,8 @@ def main(argv: Optional[List[str]] = None):
         f"{measured} op-times from REAL on-chip measurements "
         f"(measured_v5e.json), {analytic} from the "
         f"{'fitted' if fitted else 'unfitted analytic'} roofline.",
-        f"Search engine: {engine}, budget {args.budget} "
+        f"Search engine: {engine}, budget {args.budget} x "
+        f"{args.restarts} restarts, best kept "
         f"(reference: FFModel::optimize MCMC, model.cc:1056-1107).",
     ]
     if any(op._type == "Embedding" for op in model.ops):

@@ -78,7 +78,7 @@ if [ -n "$MEAS_MS" ]; then
   AGREE="--measured-single-chip-ms $MEAS_MS --single-chip-batch $MEAS_BATCH"
 fi
 python -m flexflow_tpu.tools.soap_report alexnet --batch-size "$AB" \
-    --budget 8000 $AGREE --out REPORT_SOAP.md
+    $AGREE --out REPORT_SOAP.md
 python -m flexflow_tpu.tools.soap_report nmt  --out REPORT_SOAP_NMT.md
 python -m flexflow_tpu.tools.soap_report dlrm --out REPORT_SOAP_DLRM.md
 # BASELINE config #5: ResNet-50, searched strategy, v5e-64 multi-host
