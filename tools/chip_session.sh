@@ -24,6 +24,18 @@ AB=${ALEXNET_BATCH:-64}
 # earlier window must never pose as this run's measurement in the
 # agreement check below)
 rm -f BENCH_EXTRA.json
+# Stale-PROFILE_v5e.md guard, unconditional (not inside the MEAS_MS
+# gate below — it must hold even when this window's bench fails and
+# stage 2b is skipped): an UNTRACKED leftover from a window that died
+# before its commit must never be committed under this window's
+# provenance, and uncommitted local edits to a tracked copy are
+# dropped for the same reason.  A tracked, unchanged copy stays put —
+# it already carries its own window's committed provenance.
+if git ls-files --error-unmatch PROFILE_v5e.md >/dev/null 2>&1; then
+  git checkout -- PROFILE_v5e.md 2>/dev/null || true
+else
+  rm -f PROFILE_v5e.md
+fi
 timeout 1500 python bench.py | tee /tmp/bench_line.json || true
 
 # 2. single-chip agreement inputs: measured ms/step for the bench
@@ -57,6 +69,55 @@ elif grep -q '"error"' /tmp/bench_line.json 2>/dev/null \
     && ! grep -q 'watchdog' /tmp/bench_line.json 2>/dev/null; then
   echo "chip_session: bench failed in SOFTWARE (see /tmp/bench_line.json); chip presumed healthy"
   WEDGED=0
+fi
+
+# 2b. per-op profile table (committed artifact; the reference's
+# --profiling per-op printouts, conv_2d.cu:448-473).  BEFORE the
+# calibrate stage: calibration's 33-min budget outlives every window
+# observed so far, so anything sequenced after it never runs — and with
+# the warm XLA compile cache this costs ~2 min.  Cleared first: a file
+# left by an earlier window that died before its commit must not be
+# committed under THIS window's provenance.
+if [ -n "$MEAS_MS" ]; then
+  PR_RC=0
+  timeout 600 python -m flexflow_tpu.tools.profile_report alexnet \
+      --batch-size "$MEAS_BATCH" --out PROFILE_v5e.md || PR_RC=$?
+  if [ "$PR_RC" != 0 ]; then
+    # a timed-out/crashed profile_report must not leave a partial table
+    # for stage 7 to commit — same restore-or-delete guard as the top
+    if git ls-files --error-unmatch PROFILE_v5e.md >/dev/null 2>&1; then
+      git checkout -- PROFILE_v5e.md 2>/dev/null || true
+    else
+      rm -f PROFILE_v5e.md
+    fi
+  fi
+  if [ "$PR_RC" = 124 ]; then
+    # The timeout is ambiguous: a tunnel wedge (every op hangs) or a
+    # software hang in profile_report on a healthy chip.  Discriminate
+    # with the shared probe (tools/tpu_probe.py, same one tpu_watch.sh
+    # polls with) — a wrong "wedged" call here disables calibrate for
+    # the window, a wrong "healthy" call burns calibrate's budget
+    # against a dead chip.  Two attempts with a pause: the SIGTERMed
+    # profile_report may not have released the device yet, and a fast
+    # init failure in that race must not read as a wedge (stderr kept
+    # in /tmp/cs_probe.err for the post-mortem).
+    PROBE_OK=0
+    for _try in 1 2; do
+      if timeout 90 python tools/tpu_probe.py \
+          >/tmp/cs_probe.out 2>/tmp/cs_probe.err \
+          && grep -q TPU_OK /tmp/cs_probe.out; then
+        PROBE_OK=1
+        break
+      fi
+      [ "$_try" = 2 ] || sleep 20
+    done
+    if [ "$PROBE_OK" = 1 ]; then
+      echo "chip_session: profile_report timed out but the chip answers — software hang, continuing"
+    else
+      echo "chip_session: profile_report timed out and the probe fails (see /tmp/cs_probe.err) — chip wedged, skipping remaining on-chip stages"
+      WEDGED=1
+    fi
+  fi
 fi
 
 # 3. measure + fit (supervised worker; wedge-proof, resumes from cache;
@@ -126,22 +187,17 @@ fi
 # kernel timeline — clear it whether or not this window profiles.
 rm -rf /tmp/flexflow_tpu_trace
 
-# 5+6 run only when the bench actually landed: hammering a wedged chip
-# with a 30-min profile + sweep just delays the watcher's next probe —
-# re-arming fast is what converts the next window.
-if [ -n "$MEAS_MS" ]; then
+# 5+6 run only when the bench actually landed AND the chip is still
+# answering (stage 2b's probe can flip WEDGED after a mid-window
+# wedge): hammering a wedged chip with a 30-min profile + sweep just
+# delays the watcher's next probe — re-arming fast is what converts
+# the next window.
+if [ -n "$MEAS_MS" ] && [ "$WEDGED" = 0 ]; then
   # 5. XLA profiler trace of the AlexNet step, before the sweep: it is
   # the input to the measured-optimization work (kernel timeline, HBM
   # traffic, fusion boundaries) and a fraction of the sweep's cost.
+  # (The committed per-op table ran earlier, stage 2b.)
   timeout 600 python bench.py --profile /tmp/flexflow_tpu_trace || true
-
-  # 5b. per-op profile table (committed artifact; the reference's
-  # --profiling per-op printouts, conv_2d.cu:448-473).  Cleared first:
-  # a file left by an earlier window that died before its commit must
-  # not be committed under THIS window's provenance.
-  rm -f PROFILE_v5e.md
-  timeout 600 python -m flexflow_tpu.tools.profile_report alexnet \
-      --batch-size "$MEAS_BATCH" --out PROFILE_v5e.md || true
 
   # 6. batch x dtype sweep (writes BENCH_SWEEP.md incrementally)
   if [ -z "${SKIP_SWEEP:-}" ]; then

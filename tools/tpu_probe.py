@@ -1,0 +1,15 @@
+"""Cheap TPU health probe — the ONE shared definition used by both
+tools/tpu_watch.sh (poll loop) and tools/chip_session.sh (mid-window
+wedge discrimination).  Runs real device work (a wedged tunnel hangs
+backend init forever, so callers MUST wrap this in `timeout`) and
+rejects a silent CPU fallback.  Prints "TPU_OK <kind> <checksum>" on
+success; any hang, exception, or non-TPU backend means unhealthy.
+"""
+import jax
+import jax.numpy as jnp
+
+d = jax.devices()[0]
+assert d.platform == "tpu", f"not a TPU: {d.platform}"
+x = jnp.ones((256, 256), jnp.bfloat16)
+s = float(jax.device_get((x @ x).astype(jnp.float32).sum()))
+print("TPU_OK", d.device_kind.replace(" ", "_"), s)

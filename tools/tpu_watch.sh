@@ -17,15 +17,10 @@ BUDGET=${1:-39600}
 INTERVAL=${INTERVAL:-300}
 START=$(date +%s)
 
-# The probe must run device work (a wedged tunnel hangs backend init
-# forever, so only a killable subprocess with a hard timeout is safe)
-# and must reject a silent CPU fallback.
-PROBE='import jax, jax.numpy as jnp
-d = jax.devices()[0]
-assert d.platform == "tpu", f"not a TPU: {d.platform}"
-x = jnp.ones((256, 256), jnp.bfloat16)
-s = float(jax.device_get((x @ x).astype(jnp.float32).sum()))
-print("TPU_OK", d.device_kind.replace(" ", "_"), s)'
+# The probe (shared definition: tools/tpu_probe.py — same one
+# chip_session.sh uses for mid-window wedge discrimination) must run
+# device work in a killable subprocess with a hard timeout, and must
+# reject a silent CPU fallback.
 
 n=0
 while :; do
@@ -40,7 +35,7 @@ while :; do
   # burns its whole timeout — the timeout sets the polling cadence, and
   # cadence is what catches short windows.  (The doctor's accelerator
   # probe uses the same 90 s bound.)
-  if timeout 90 python -c "$PROBE" >/tmp/tpu_probe.out 2>/tmp/tpu_probe.err \
+  if timeout 90 python tools/tpu_probe.py >/tmp/tpu_probe.out 2>/tmp/tpu_probe.err \
       && grep -q TPU_OK /tmp/tpu_probe.out; then
     echo "tpu_watch: TPU healthy at $(date -u +%FT%TZ) (probe #$n) — firing chip_session"
     touch /tmp/TPU_ALIVE
