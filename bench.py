@@ -420,7 +420,10 @@ def profile(out="/tmp/flexflow_tpu_trace"):
 
 def main():
     if "--sweep" in sys.argv:
-        sweep()
+        idx = sys.argv.index("--sweep")
+        out = (sys.argv[idx + 1] if len(sys.argv) > idx + 1
+               else "BENCH_SWEEP.md")
+        sweep(out)
         return
     if "--profile" in sys.argv:
         idx = sys.argv.index("--profile")
