@@ -425,7 +425,7 @@ def build_job_list(cost, devices: int, alexnet_batch: int, bench_batch: int,
     skips job enumeration but still builds the model list (including
     the legacy batch-1024 AlexNet space, so the first converted
     window's cache entries keep feeding every refit)."""
-    from .report_configs import REPORT_GLOBAL_BATCH
+    from .report_configs import REPORT_DEVICES, REPORT_GLOBAL_BATCH
 
     models, nds = [], []
     mb = _model("alexnet", bench_batch, 1)
@@ -467,6 +467,73 @@ def build_job_list(cost, devices: int, alexnet_batch: int, bench_batch: int,
                 ijobs = ijobs[::stride][:inception_jobs]
             rest += ijobs
     rest.sort(key=lambda j: cost._analytic(j[0], j[1], j[2]))
+    # Front the keys the SOAP reports actually price (report_keys.json,
+    # written by soap_report on every run): a window lands ~60 of the
+    # ~654 jobs, and these are the ones that raise each report's
+    # measured-provenance count instead of landing at random.  Both
+    # partitions stay cheapest-analytic-first.
+    try:
+        from .report_configs import report_keys_path
+
+        with open(report_keys_path()) as f:
+            raw = json.load(f)
+        # entries are {"devices": N, "batch": B, "keys": [...]} (legacy
+        # plain lists accepted, scale assumed canonical)
+        keys_by_model = {
+            name: (e if isinstance(e, dict) else
+                   {"devices": REPORT_DEVICES.get(name), "batch": None,
+                    "keys": e})
+            for name, e in raw.items()}
+    except Exception:
+        keys_by_model = {}
+    if keys_by_model:
+        # Models whose report scale is not enumerated above (either not
+        # in --models at all, or in it at a DIFFERENT device count /
+        # batch than the report prices — shard-shape keys only match at
+        # the same scale) get TARGETED jobs: exactly the keys their
+        # reports price, nothing else, so "simulation-only at report
+        # scale" becomes measurable without ballooning the job space.
+        # Their models also join the fit-record enumeration so landed
+        # measurements feed the per-family roofline refits.
+        from ..simulator.native_search import enumerate_candidates
+
+        targeted = []
+        seen = {j[3] for j in jobs} | {j[3] for j in rest}
+        for name, entry in keys_by_model.items():
+            nd_r = entry.get("devices") or REPORT_DEVICES.get(name,
+                                                              devices)
+            b_r = entry.get("batch") or REPORT_GLOBAL_BATCH.get(name,
+                                                                1024)
+            if name in wanted:
+                enum_b = (alexnet_batch if name == "alexnet"
+                          else (report_batch if report_batch is not None
+                                else REPORT_GLOBAL_BATCH.get(name, 1024)))
+                if devices == nd_r and enum_b == b_r:
+                    continue  # enumerated space already matches the hint
+            try:
+                mt = _model(name, b_r, nd_r)
+            except Exception:
+                continue
+            models.append(mt)
+            nds.append(nd_r)
+            if fit_only:
+                continue
+            kset = set(entry.get("keys") or [])
+            for op in mt.ops:
+                for pc in enumerate_candidates(op, nd_r):
+                    pc = op.legalize_pc(pc)
+                    for which in ("forward", "backward"):
+                        key = cost._key(op, pc, which)
+                        if (key in kset and key not in seen
+                                and key not in cost._measured):
+                            seen.add(key)
+                            targeted.append((op, pc, which, key))
+        prio_keys = set()
+        for entry in keys_by_model.values():
+            prio_keys.update(entry.get("keys") or [])
+        priority = [j for j in rest if j[3] in prio_keys] + targeted
+        priority.sort(key=lambda j: cost._analytic(j[0], j[1], j[2]))
+        rest = priority + [j for j in rest if j[3] not in prio_keys]
     return jobs + rest, models, nds
 
 

@@ -28,6 +28,19 @@ REPORT_GLOBAL_BATCH = {
     "inception": 256,
 }
 
+# machine size each model's SOAP report simulates (alexnet/dlrm/nmt at
+# the 16-chip BASELINE configs; resnet config #5 at v5e-64; inception
+# config #2's shape at 8 chips).  calibrate uses this to synthesize
+# targeted jobs for the report shapes of models whose full candidate
+# space it does not enumerate.
+REPORT_DEVICES = {
+    "alexnet": 16,
+    "dlrm": 16,
+    "nmt": 16,
+    "resnet": 64,
+    "inception": 8,
+}
+
 # single-chip bench config (bench.py's AlexNet phase) — also the
 # simulated-vs-measured agreement config
 BENCH_SINGLE_CHIP_BATCH = 256
@@ -41,6 +54,21 @@ THIN_FIT_OP_TYPES = 3
 # many TPU entries (the default ~654-job space is majority-measured);
 # shrink alongside --models if the job space is narrowed.
 CALIBRATION_TARGET_ENTRIES = 350
+
+def report_keys_path():
+    """The ONE resolution of the calibration-priority hint file
+    (written by soap_report, consumed by calibrate.build_job_list).
+    FF_REPORT_KEYS_PATH diverts it — tests set that to a scratch path
+    so small-config runs can never overwrite the committed hints."""
+    import os
+
+    from ..simulator.machine import CALIBRATION_PATH
+
+    return os.environ.get(
+        "FF_REPORT_KEYS_PATH",
+        os.path.join(os.path.dirname(CALIBRATION_PATH),
+                     "report_keys.json"))
+
 
 # Annealing budget per model for the SOAP reports.  The per-iteration
 # cost differs by orders of magnitude across models (alexnet's space

@@ -13,6 +13,11 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Tests invoking soap_report (any config) must not overwrite the repo's
+# committed calibration-priority hints (flexflow_tpu/simulator/
+# report_keys.json) with their tiny test configs.
+os.environ.setdefault("FF_REPORT_KEYS_PATH", "/tmp/ff_test_report_keys.json")
+
 import jax  # noqa: E402
 
 # The axon sitecustomize force-selects the TPU backend at interpreter boot

@@ -133,7 +133,7 @@ def test_calibrate_host_transfer_measure_and_fit(tmp_path, devices):
     assert data["host_xfer:1048576"]["platform"] == "cpu"
 
 
-def test_calibrate_job_list_order(devices, tmp_path):
+def test_calibrate_job_list_order(devices, tmp_path, monkeypatch):
     """Short-window job ordering contract: the single-chip bench shapes
     (agreement-check anchors) lead, the remaining candidate spaces run
     cheapest-analytic-first, and the report models' spaces are present
@@ -143,6 +143,10 @@ def test_calibrate_job_list_order(devices, tmp_path):
     from flexflow_tpu.tools.calibrate import (_model, build_job_list,
                                               candidate_jobs)
 
+    # no report-keys hint for the base contract (the separate priority
+    # test covers the hinted ordering)
+    monkeypatch.setenv("FF_REPORT_KEYS_PATH",
+                       str(tmp_path / "absent_keys.json"))
     # an isolated (empty) measured cache: the packaged measured_v5e.json
     # would dedupe any matching candidate keys out of the job list and
     # make this test flap on data-only commits
@@ -182,6 +186,66 @@ def test_calibrate_job_list_order(devices, tmp_path):
     assert jobs2 == []
     assert any(any(op.output.dims[0] == 1024 for op in m.ops)
                for m in models2), "legacy 1024 space must stay fit-eligible"
+
+
+def test_calibrate_report_keys_priority(devices, tmp_path, monkeypatch):
+    """report_keys.json fronts the exact keys the SOAP reports price:
+    those jobs run first (after the bench anchors) so a short window's
+    ~60 measurements raise report provenance instead of landing at
+    random; keys for a model whose report scale is NOT in the
+    enumerated spaces (inception@8) are synthesized as targeted jobs."""
+    import json
+
+    from flexflow_tpu.simulator.cost_model import CostModel
+    from flexflow_tpu.simulator.machine import TPUMachineModel
+    from flexflow_tpu.tools.calibrate import (_model, build_job_list,
+                                              candidate_jobs)
+
+    empty_cache = str(tmp_path / "empty_cache.json")
+
+    def fresh_cost():
+        return CostModel(TPUMachineModel(num_devices=16),
+                         cache_path=empty_cache,
+                         measured_cache_path=empty_cache)
+
+    # harvest real keys: a mid-list slice of the dlrm space, plus the
+    # inception@8 DP keys (what its DP-optimal report actually prices)
+    monkeypatch.setenv("FF_REPORT_KEYS_PATH",
+                       str(tmp_path / "absent_keys.json"))
+    cost = fresh_cost()
+    base, _, _ = build_job_list(
+        cost, devices=16, alexnet_batch=64, bench_batch=256,
+        models_csv="dlrm", report_batch=None,
+        inception=False, inception_jobs=0, fit_only=False)
+    n_bench = len(candidate_jobs(_model("alexnet", 256, 1), 1,
+                                 fresh_cost(), full=False))
+    mid = [j[3] for j in base[n_bench:]][len(base) // 2:len(base) // 2 + 6]
+    assert len(mid) >= 4
+    inc_keys = [j[3] for j in
+                candidate_jobs(_model("inception", 256, 8), 8,
+                               fresh_cost(), full=False)]
+    assert inc_keys
+
+    keys_path = tmp_path / "report_keys.json"
+    keys_path.write_text(json.dumps({"dlrm": mid, "inception": inc_keys}))
+    monkeypatch.setenv("FF_REPORT_KEYS_PATH", str(keys_path))
+    cost2 = fresh_cost()
+    jobs, models, nds = build_job_list(
+        cost2, devices=16, alexnet_batch=64, bench_batch=256,
+        models_csv="dlrm", report_batch=None,
+        inception=False, inception_jobs=0, fit_only=False)
+
+    hinted = set(mid) | set(inc_keys)
+    pos = [i for i, j in enumerate(jobs) if j[3] in hinted]
+    # every hinted key is measurable exactly once (the inception@8 ones
+    # only via targeted synthesis), and none is buried past the front
+    # region (cache keys are shape-based, so a hinted key can also
+    # coincide with a bench-anchor job — e.g. both ImageNet heads emit
+    # the same Softmax key — which only moves it EARLIER)
+    assert len(pos) == len(hinted)
+    assert max(pos) < n_bench + len(hinted)
+    # targeted models join the fit-record enumeration at report scale
+    assert 8 in nds
 
 
 def test_fit_machine_per_family(devices):

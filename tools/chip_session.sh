@@ -269,7 +269,8 @@ for f in BENCH_EXTRA.json BENCH_SWEEP.md PROFILE_v5e.md CALIBRATION.md \
          REPORT_SOAP.md REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
          REPORT_SOAP_RESNET.md REPORT_SOAP_INCEPTION.md \
          flexflow_tpu/simulator/measured_v5e.json \
-         flexflow_tpu/simulator/machine_v5e.json; do
+         flexflow_tpu/simulator/machine_v5e.json \
+         flexflow_tpu/simulator/report_keys.json; do
   [ -f "$f" ] && ARTS="$ARTS $f"
 done
 if [ -n "$ARTS" ]; then
