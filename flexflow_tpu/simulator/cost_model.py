@@ -128,8 +128,15 @@ class CostModel:
         # bytes: inputs read + weights read + outputs written for this part
         in_vol = sum(int(np.prod([hi - lo + 1 for lo, hi in op.input_ranges(j, pc, 0)]))
                      for j in range(len(op.inputs)))
-        w_vol = sum(int(np.prod([hi - lo + 1 for lo, hi in op.weight_tile(pc, wi, 0)]))
-                    for wi in range(len(op.weights)))
+        # A weight-SHARING op (share_with: embed_dst reads embed_src's
+        # table) has no weights of its own, but its forward physically
+        # reads the shared tensor — price the owner's weights, not zero.
+        # This also makes the cache key honest: owner and sharer have
+        # identical shapes AND now identical costs, so their colliding
+        # keys describe the same physical computation.
+        w_op = op.share_from if getattr(op, "share_from", None) else op
+        w_vol = sum(int(np.prod([hi - lo + 1 for lo, hi in w_op.weight_tile(pc, wi, 0)]))
+                    for wi in range(len(w_op.weights)))
         out_vol = int(np.prod(sub))
         bytes_moved = self._dtype_bytes * (in_vol + w_vol + out_vol)
         fam = type(op).__name__

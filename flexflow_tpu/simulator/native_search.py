@@ -5,9 +5,10 @@ native/ffsearch.cpp (the analogue of the reference's pure-C++ offline
 searcher, scripts/simulator.cc:1420-1472).  This module enumerates each
 op's legal SOAP candidate configs with analytic costs and partition
 rectangles, flattens everything to arrays, and drives the engine via
-ctypes.  Falls back to the Python ``mcmc_search`` when the library is
-unavailable or the graph uses features the native path doesn't cover
-(multi-output ops).
+ctypes.  Handles multi-output ops (LSTM hidden+cell: each consumer edge
+records the producer's output slot) and weight sharing (priced at the
+owner, cost_model._analytic).  Falls back to the Python ``mcmc_search``
+only when the library is unavailable.
 """
 
 from __future__ import annotations
@@ -86,8 +87,9 @@ def native_lib() -> Optional[ctypes.CDLL]:
         lib.ffsearch_anneal.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
-            ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
-            i32p, i32p, i32p, i64p, i32p,
+            ctypes.c_int32, i32p, i32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            i32p, i32p, i32p, i32p, i64p, i32p,
             i32p, i32p, f64p, f64p, i64p, i64p, i64p, i64p, i64p, i64p,
             ctypes.c_int32, ctypes.c_double, ctypes.c_uint64, ctypes.c_int32,
             i32p, i32p, f64p,
@@ -107,15 +109,18 @@ def _ptr(a, ct):
 def native_mcmc_search(model, budget: int, alpha: float = 0.05,
                        machine_model: Optional[TPUMachineModel] = None,
                        seed: int = 0, overlap: bool = False,
-                       verbose: bool = True):
+                       verbose: bool = True, init_strategies=None):
     """Returns (best strategies dict, best simulated runtime, dp runtime)
-    or None when the native engine can't handle this graph."""
+    or None when the native engine can't handle this graph.
+
+    ``init_strategies``: optional {op name: ParallelConfig} warm start —
+    the anneal begins from this plan instead of data parallel (and with
+    budget=0 the returned dp-runtime slot is the native engine's
+    evaluation of exactly this plan, which the parity tests use)."""
     lib = native_lib()
     if lib is None:
         return None
     ops = model.ops
-    if any(len(op.outputs) != 1 for op in ops):
-        return None
 
     nd = machine_model.num_devices if machine_model else model.config.num_devices
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
@@ -126,11 +131,16 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
     op_index = {id(op): i for i, op in enumerate(ops)}
     max_inputs = max(1, max(len(op.inputs) for op in ops))
     max_weights = max(1, max(len(op.weights) for op in ops))
+    # multi-output ops (LSTM hidden+cell, …): each consumer edge records
+    # WHICH producer output slot feeds it, mirroring the python
+    # simulator's pre.output_tile(pre_pc, src_id, tin.owner_idx)
+    max_outputs = max(1, max(len(op.outputs) for op in ops))
 
     num_inputs = np.zeros(L, np.int32)
     num_weights = np.zeros(L, np.int32)
     in_rank = np.zeros(L * max_inputs, np.int32)
     producer = np.full(L * max_inputs, -1, np.int32)
+    producer_out = np.zeros(L * max_inputs, np.int32)
     w_rank = np.zeros(L * max_weights, np.int32)
     # embeddings: grad sync touches at most the batch's rows (mirrors
     # simulator.py's sparse clamp — ONE objective for both engines)
@@ -149,6 +159,7 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
             pre = tin.owner_op
             producer[i * max_inputs + j] = (
                 op_index.get(id(pre), -1) if pre is not None else -1)
+            producer_out[i * max_inputs + j] = getattr(tin, "owner_idx", 0)
         cands = enumerate_candidates(op, nd, model=model)
         cands = [model._legalize_pc(op, pc) if hasattr(model, "_legalize_pc")
                  else pc for pc in cands]
@@ -183,13 +194,26 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
 
     for i, op in enumerate(ops):
         cands = cand_lists[i]
-        dp = ParallelConfig.data_parallel(op.output.num_dims, nd)
-        dp = model._legalize_pc(op, dp) if hasattr(model, "_legalize_pc") else dp
+        want = None
+        if init_strategies is not None:
+            want = init_strategies.get(op.name)
+        if want is None:
+            want = ParallelConfig.data_parallel(op.output.num_dims, nd)
+        want = (model._legalize_pc(op, want)
+                if hasattr(model, "_legalize_pc") else want)
         init_idx = 0
+        exact = None
         for ci, pc in enumerate(cands):
-            if pc.dims == dp.dims:
-                init_idx = ci
-                break
+            if (pc.dims == want.dims
+                    and pc.device_type == want.device_type):
+                if exact is None:
+                    exact = ci  # dims+type match: acceptable fallback
+                if (pc.device_ids[:pc.num_parts()]
+                        == want.device_ids[:want.num_parts()]):
+                    exact = ci  # full match incl. placement
+                    break
+        if exact is not None:
+            init_idx = exact
         choice_init[i] = init_idx
         for ci, pc in enumerate(cands):
             P = pc.num_parts()
@@ -206,8 +230,12 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
             bwd_l.append(cost.op_time(op, pc, "backward"))
             dev_off.append(len(devices))
             devices.extend(ids)
-            out_off.append(push_rects(
-                [op.output_tile(pc, p) for p in range(P)]))
+            for k in range(max_outputs):
+                if k < len(op.outputs):
+                    out_off.append(push_rects(
+                        [op.output_tile(pc, p, k) for p in range(P)]))
+                else:
+                    out_off.append(0)
             for j in range(max_inputs):
                 if j < len(op.inputs):
                     rlist = [op.input_ranges(j, pc, p) for p in range(P)]
@@ -232,6 +260,7 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
     a_num_weights = _as(num_weights, np.int32)
     a_in_rank = _as(in_rank, np.int32)
     a_producer = _as(producer, np.int32)
+    a_producer_out = _as(producer_out, np.int32)
     a_w_rank = _as(w_rank, np.int32)
     a_sync_cap = _as(sync_rows_cap, np.int64)
     a_out_rank = _as(out_rank, np.int32)
@@ -253,8 +282,9 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
         mm.ici_bandwidth, mm.dcn_bandwidth, cost._dtype_bytes,
         L, _ptr(a_num_inputs, ctypes.c_int32),
         _ptr(a_num_weights, ctypes.c_int32),
-        max_inputs, max_weights,
+        max_inputs, max_weights, max_outputs,
         _ptr(a_in_rank, ctypes.c_int32), _ptr(a_producer, ctypes.c_int32),
+        _ptr(a_producer_out, ctypes.c_int32),
         _ptr(a_w_rank, ctypes.c_int32), _ptr(a_sync_cap, ctypes.c_int64),
         _ptr(a_out_rank, ctypes.c_int32),
         _ptr(a_cand_off, ctypes.c_int32), _ptr(a_parts, ctypes.c_int32),

@@ -223,6 +223,21 @@ class Simulator:
                         for g in group:
                             bwd[(li, g)].add_next(upd)
 
+        import os
+        if os.environ.get("FFSEARCH_DUMP"):
+            # one-shot task-graph dump mirroring ffsearch.cpp's (parity
+            # debugging: diff the two engines' graphs for one strategy)
+            import sys as _sys
+            index = {id(t): i for i, t in enumerate(tasks)}
+            for i, t in enumerate(tasks):
+                print(f"PYTASK {i} {t.run_time!r} {t.device} {t.name}",
+                      file=_sys.stderr)
+            for t in tasks:
+                for nt in t.next:
+                    print(f"PYEDGE {index[id(t)]} {index[id(nt)]}",
+                          file=_sys.stderr)
+            print("PYENDDUMP", file=_sys.stderr)
+
         # Steps 4-5: event-driven simulation — native C++ engine when built
         # (native/ffsim.cpp), Python fallback otherwise.
         native = self._simulate_native(tasks)

@@ -18,6 +18,8 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <queue>
 #include <random>
@@ -71,7 +73,9 @@ struct Candidate {
   int32_t parts;
   const int32_t* devices;            // [parts]
   double fwd_cost, bwd_cost;
-  const int64_t* out_tiles;          // [parts][out_rank][2]
+  // per output slot k: tiles [parts][rank_k][2] (multi-output ops —
+  // e.g. LSTM hidden+cell — feed consumers from different slots)
+  std::vector<const int64_t*> out_tiles;
   // per input j: rects [parts][in_rank_j][2], laid out input-major
   std::vector<const int64_t*> in_rects;
   // per weight w: rects [parts][w_rank_w][2]
@@ -83,6 +87,7 @@ struct OpDesc {
   std::vector<int32_t> in_rank;      // rank of each input's rects
   std::vector<int32_t> w_rank;       // rank of each weight tile
   std::vector<int32_t> producer;     // producing op index per input, -1 if graph input
+  std::vector<int32_t> producer_out; // producing op's OUTPUT SLOT per input
   // Row-sparse grad-sync clamp per weight (embeddings: the gradient
   // touches at most the batch's rows — simulator.py's clamp, mirrored
   // here so both engines share one objective).  -1 = no clamp; else the
@@ -174,7 +179,8 @@ struct Sim {
         const Candidate& pcand = O[pi].cands[choice[pi]];
         int rank = od.in_rank[j];
         const int64_t* dst_rects = c.in_rects[j];
-        const int64_t* src_rects = pcand.out_tiles;
+        const int64_t* src_rects =
+            pcand.out_tiles[size_t(od.producer_out[j])];
         for (int dp = 0; dp < c.parts; dp++) {
           const int64_t* dr = dst_rects + size_t(dp) * rank * 2;
           int ddev = norm(c.devices[dp]);
@@ -255,6 +261,16 @@ struct Sim {
         }
       }
     }
+    if (std::getenv("FFSEARCH_DUMP")) {
+      // one-shot task-graph dump for parity debugging against the
+      // python simulator (tests/tools diff the two graphs)
+      for (size_t t = 0; t < run_time.size(); t++)
+        std::fprintf(stderr, "TASK %zu %.17g %lld\n", t, run_time[t],
+                     (long long)device[t]);
+      for (size_t e = 0; e < edge_src.size(); e++)
+        std::fprintf(stderr, "EDGE %d %d\n", edge_src[e], edge_dst[e]);
+      std::fprintf(stderr, "ENDDUMP\n");
+    }
     return simulate();
   }
 
@@ -311,11 +327,12 @@ extern "C" {
 //   offsets below.  For op i, candidate c (global index g = cand_off[i]+c):
 //     parts[g], fwd_cost[g], bwd_cost[g]
 //     devices:  dev_off[g] indexes into devices[] ([parts] entries)
-//     out tiles: out_off[g] indexes into rects[] ([parts*out_rank*2])
+//     out tiles: out_off[g*max_outputs + k] indexes into rects[]
+//              ([parts*rank_k*2]) for output slot k; unused slots 0
 //     inputs:  op i has num_inputs[i] inputs; in_rank at in_rank_off[i]..;
-//              producer at same offsets; rect offsets per (g, j) at
-//              in_rect_off[in_off[i]*? ] — laid out per-candidate:
-//              in_rect_off[g * max_inputs + j]
+//              producer / producer_out (the producing op's output slot)
+//              at same offsets; rect offsets per (g, j) laid out
+//              per-candidate: in_rect_off[g * max_inputs + j]
 //     weights: num_weights[i]; w_rank at w_rank_off[i]+w;
 //              w_tile_off[g * max_weights + w]
 //   choice_init[L]: starting candidate per op (data parallel).
@@ -327,12 +344,13 @@ double ffsearch_anneal(
     int32_t torus_y, double ici_bw, double dcn_bw, double elem_bytes,
     // graph
     int32_t L, const int32_t* num_inputs, const int32_t* num_weights,
-    int32_t max_inputs, int32_t max_weights,
+    int32_t max_inputs, int32_t max_weights, int32_t max_outputs,
     const int32_t* in_rank,    // [L*max_inputs]
     const int32_t* producer,   // [L*max_inputs]
+    const int32_t* producer_out,  // [L*max_inputs] producer's output slot
     const int32_t* w_rank,     // [L*max_weights]
     const int64_t* sync_rows_cap,  // [L*max_weights]; -1 = no clamp
-    const int32_t* out_rank,   // [L]
+    const int32_t* out_rank,   // [L] (rank of output slot 0; informational)
     // candidates
     const int32_t* cand_off,   // [L+1]
     const int32_t* parts,      // [G]
@@ -341,7 +359,7 @@ double ffsearch_anneal(
     const int64_t* devices,    // device pool
     const int64_t* dev_off,    // [G]
     const int64_t* rects,      // rect pool
-    const int64_t* out_off,    // [G]
+    const int64_t* out_off,    // [G*max_outputs] (slot-minor)
     const int64_t* in_rect_off,   // [G*max_inputs]
     const int64_t* w_tile_off,    // [G*max_weights]
     // search
@@ -367,6 +385,7 @@ double ffsearch_anneal(
     for (int32_t j = 0; j < num_inputs[i]; j++) {
       od.in_rank.push_back(in_rank[i * max_inputs + j]);
       od.producer.push_back(producer[i * max_inputs + j]);
+      od.producer_out.push_back(producer_out[i * max_inputs + j]);
     }
     for (int32_t w = 0; w < num_weights[i]; w++) {
       od.w_rank.push_back(w_rank[i * max_weights + w]);
@@ -378,7 +397,8 @@ double ffsearch_anneal(
       c.devices = dev_pool.data() + dev_off[g];
       c.fwd_cost = fwd_cost[g];
       c.bwd_cost = bwd_cost[g];
-      c.out_tiles = rects + out_off[g];
+      for (int32_t k = 0; k < max_outputs; k++)
+        c.out_tiles.push_back(rects + out_off[size_t(g) * max_outputs + k]);
       for (int32_t j = 0; j < num_inputs[i]; j++)
         c.in_rects.push_back(rects + in_rect_off[size_t(g) * max_inputs + j]);
       for (int32_t w = 0; w < num_weights[i]; w++)

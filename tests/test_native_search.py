@@ -54,6 +54,52 @@ def test_native_best_runtime_consistent_with_python_simulator():
     assert best_rt <= dp_rt
 
 
+def test_native_multi_output_shared_weight_parity():
+    """NMT exercises the two graph features the native engine gained in
+    round 5: multi-output ops (LSTM hidden+cell feed the decoder from
+    different output slots) and weight sharing (embed_dst reads
+    embed_src's table; its compute is priced with the OWNER's weights).
+    The searched best must price identically in both engines."""
+    model, mm, sim = _setup("nmt", nd=8)
+    r = native_mcmc_search(model, budget=2000, machine_model=mm, seed=1,
+                           verbose=False)
+    assert r is not None, "native engine must handle multi-output graphs"
+    best, best_rt, dp_rt = r
+    py_rt = sim.simulate_runtime(model, best)
+    assert best_rt == pytest.approx(py_rt, rel=1e-9)
+    assert best_rt <= dp_rt
+
+
+def test_native_warm_start():
+    """init_strategies warm-starts the anneal: with budget=0 the
+    dp-runtime slot is the native evaluation of exactly that plan."""
+    model, mm, sim = _setup()
+    best, _, _ = native_mcmc_search(model, budget=2000, machine_model=mm,
+                                    seed=2, verbose=False)
+    _, _, warm_rt = native_mcmc_search(model, budget=0, machine_model=mm,
+                                       verbose=False, init_strategies=best)
+    py_rt = sim.simulate_runtime(model, best)
+    assert warm_rt == pytest.approx(py_rt, rel=1e-9)
+
+
+def test_shared_weight_compute_priced_like_owner():
+    """A share_with op's forward reads the shared table — its analytic
+    compute cost must equal the owner's at the same config, not the
+    weightless variant (the round-5 embed_dst key-collision bug)."""
+    from flexflow_tpu.simulator.cost_model import CostModel
+
+    model, mm, _ = _setup("nmt", nd=8)
+    cost = CostModel(mm, measure=False)
+    src = next(op for op in model.ops if op.share_from is None
+               and op._type == "Embedding")
+    dst = next(op for op in model.ops if op.share_from is not None)
+    pc = ParallelConfig.data_parallel(src.output.num_dims, 8) \
+        .with_device_ids(tuple(range(8)))
+    t_src = cost._analytic(src, model._legalize_pc(src, pc), "forward")
+    t_dst = cost._analytic(dst, model._legalize_pc(dst, pc), "forward")
+    assert t_dst == pytest.approx(t_src, rel=1e-12)
+
+
 def test_native_search_speed():
     """The native engine must beat the Python engine on iterations/sec —
     a RELATIVE bound (an absolute wall-clock cap is flaky on loaded CI
