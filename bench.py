@@ -149,6 +149,24 @@ def _enter_phase(name):
     with _lock:
         _state["phase"] = name
         _state["deadline"] = time.monotonic() + PHASE_BUDGETS.get(name, 180.0)
+    _telemetry_heartbeat(name)
+
+
+def _telemetry_heartbeat(phase):
+    """Phase heartbeat into the FF_TELEMETRY trace, so a watchdog kill
+    names the wedged phase from the trace alone.  The events module is
+    stdlib-only (no jax import risk pre-preflight) and the log is
+    line-buffered, so the record survives the watchdog's os._exit.
+    Never lets telemetry break the bench."""
+    try:
+        from flexflow_tpu.observability import events
+
+        log = events.active_log()
+        if log is not None:
+            log.event("bench_phase", phase=phase)
+            log.flush()
+    except Exception:
+        pass
 
 
 def _build(name, batch_size, compute_dtype, fused=False):
@@ -204,7 +222,9 @@ def _build_warm(name, batch_size, compute_dtype, fused=False):
 
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/flexflow_tpu_jax_cache")
+    _telemetry_heartbeat("compile")
     model = _build(name, batch_size, compute_dtype, fused=fused)
+    _telemetry_heartbeat("warmup")
     model.train_iteration()
     model.train_iteration()
     model.sync()
@@ -218,6 +238,7 @@ def run_one(name, batch_size=BENCH_SINGLE_CHIP_BATCH,
     import jax
 
     model = _build_warm(name, batch_size, compute_dtype, fused=fused)
+    _telemetry_heartbeat("measure")
     t0 = time.perf_counter()
     for _ in range(steps):
         model.train_iteration()
@@ -433,6 +454,9 @@ def main():
         return
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    # initial phase is set at module load, not via _enter_phase — emit
+    # its heartbeat here (stdlib-only module: safe before jax init)
+    _telemetry_heartbeat("preflight")
     extra = _state["extra"]
 
     # ---- preflight: backend init + tiny matmul under a short deadline ----

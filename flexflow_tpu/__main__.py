@@ -30,7 +30,14 @@ def main():
             continue
         passthrough.append(a)
         i += 1
-    sys.argv = [script] + argv[1:]  # scripts parse the full flag set
+    # The script's own argparse sees only the filtered list; the full
+    # flag set stays reachable for FFConfig.parse_args(None) via the
+    # config-module stash (``python -m flexflow_tpu`` has already
+    # imported the package, so this costs nothing extra).
+    from . import config as _config
+
+    _config.set_runner_argv(argv[1:])
+    sys.argv = [script] + passthrough
     runpy.run_path(script, run_name="__main__")
     return 0
 

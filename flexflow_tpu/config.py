@@ -122,6 +122,16 @@ class ParallelConfig:
         return ParallelConfig(DeviceType.TPU, dims, tuple(range(num_devices)))
 
 
+# Full original argv stashed by the module runner (__main__.py) before it
+# rewrites sys.argv to the filtered list for the target script.
+_RUNNER_ARGV: Optional[List[str]] = None
+
+
+def set_runner_argv(argv: Sequence[str]) -> None:
+    global _RUNNER_ARGV
+    _RUNNER_ARGV = list(argv)
+
+
 def _env_default_devices() -> int:
     try:
         import jax
@@ -192,6 +202,12 @@ class FFConfig:
     # forces lazy per-touched-row updates under momentum/Adam; False
     # always streams the full table.
     sparse_host_embeddings: Optional[bool] = None
+    # Structured telemetry (observability/): step spans, phase spans,
+    # throughput/MFU counters to a JSONL trace.  ``FF_TELEMETRY=1`` in
+    # the environment enables it too; ``telemetry_file`` (or
+    # ``FF_TELEMETRY_FILE``) overrides the default ff_trace.jsonl.
+    telemetry: bool = False
+    telemetry_file: str = ""
     # Per-op strategies, keyed by op name (the reference keys an equivalent
     # map by hash(op name) — include/config.h:102, strategy.cc:23-26; the
     # hash is an implementation detail of Legion mapper tags that the TPU
@@ -215,9 +231,16 @@ class FFConfig:
         (``-ll:gpu`` → ``-ll:tpu``).
         """
         if argv is None:
-            import sys
+            # The module runner (``python -m flexflow_tpu script ...``)
+            # rewrites sys.argv to the FILTERED args but stashes the full
+            # original list here so framework flags stay reachable.
+            if _RUNNER_ARGV is not None:
+                argv = _RUNNER_ARGV
+            else:
+                import sys
 
-        argv = list(argv if argv is not None else sys.argv[1:])
+                argv = sys.argv[1:]
+        argv = list(argv)
         rest: List[str] = []
         i = 0
 
@@ -282,6 +305,11 @@ class FFConfig:
                 self.sparse_host_embeddings = True
             elif a == "--no-sparse-host-embeddings":
                 self.sparse_host_embeddings = False
+            elif a == "--telemetry":
+                self.telemetry = True
+            elif a == "--telemetry-file":
+                self.telemetry = True
+                self.telemetry_file = take()
             else:
                 rest.append(a)
             i += 1

@@ -191,15 +191,22 @@ class BaseModel:
         for cb in callbacks:
             cb.set_model(self)
             cb.on_train_begin()
+        import contextlib
+
+        tel = getattr(ff, "_telemetry", None)
         for epoch in range(epochs):
             dl.reset()
             ff.reset_metrics()
             ff.optimizer.next_epoch()
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
-            for _ in range(dl.num_batches()):
-                dl.next_batch(ff)
-                ff.train_iteration()
+            span = tel.span("fit_epoch", epoch=epoch,
+                            num_batches=dl.num_batches()) \
+                if tel is not None else contextlib.nullcontext()
+            with span:
+                for _ in range(dl.num_batches()):
+                    dl.next_batch(ff)
+                    ff.train_iteration()
             pm = ff.get_metrics()
             logs = self._logs_from(pm)
             if verbose:

@@ -123,6 +123,11 @@ class FFModel:
         self._compiled = False
         self._pipeline_req = None
         self._pipeline_plan = None
+        # Telemetry handles, resolved ONCE at compile() (observability/):
+        # None when disabled, so the hot path pays a single attribute
+        # check and makes zero event-log calls.
+        self._telemetry = None
+        self._stepstats = None
 
     # ------------------------------------------------------------------
     # graph construction
@@ -761,7 +766,30 @@ class FFModel:
         Mirrors FFModel::compile (src/runtime/model.cc:986-1046): optional
         strategy import / search, per-op partition resolution, label tensor
         creation, optimizer wiring.
+
+        Telemetry (observability/) is resolved here — the one place a
+        model learns whether ``FFConfig.telemetry`` / ``FF_TELEMETRY`` is
+        set — so every later step guards on a plain ``None`` handle.
         """
+        from .observability import events as _ff_events
+
+        self._telemetry = _ff_events.for_config(self.config)
+        if self._telemetry is None:
+            self._stepstats = None
+            return self._compile_impl(optimizer, loss_type, metrics, machine)
+        with self._telemetry.span("compile", num_ops=len(self.ops)) as at:
+            self._compile_impl(optimizer, loss_type, metrics, machine)
+            at["num_devices"] = self.machine.num_devices
+            at["batch_size"] = self.config.batch_size
+        from .observability.stepstats import StepStats
+
+        self._stepstats = StepStats(self, self._telemetry)
+        self._telemetry.flush()
+
+    def _compile_impl(self, optimizer=None,
+                      loss_type: str = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                      metrics: Sequence[str] = (MetricsType.ACCURACY,),
+                      machine: Optional[Machine] = None) -> None:
         cfg = self.config
         self.optimizer = optimizer
         self.loss = Loss(loss_type)
@@ -1777,6 +1805,13 @@ class FFModel:
                 "mse_loss", "rmse_loss", "mae_loss", "loss", "steps"]
 
     def update(self) -> None:
+        # _stepstats is non-None only under telemetry; the disabled path
+        # is a single attribute test.
+        if self._stepstats is not None:
+            return self._stepstats.timed_update(self._update_impl)
+        self._update_impl()
+
+    def _update_impl(self) -> None:
         assert self._batch is not None, "no batch loaded: call a DataLoader first"
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
@@ -2254,7 +2289,11 @@ class FFModel:
 
     def _drain_metrics(self) -> None:
         if self._metric_acc is not None:
-            vec = jax.device_get(self._metric_acc)  # single small transfer
+            if self._telemetry is not None:
+                with self._telemetry.span("metric_drain"):
+                    vec = jax.device_get(self._metric_acc)
+            else:
+                vec = jax.device_get(self._metric_acc)  # single small transfer
             totals = dict(zip(self._metric_keys(), [float(v) for v in vec]))
             steps = totals.pop("steps", 0.0)
             loss_sum = totals.pop("loss", None)

@@ -1,0 +1,28 @@
+"""Structured telemetry (events + per-step stats).
+
+The reference ships two observability channels: per-op ``--profiling``
+printouts (conv_2d.cu:448-473) and the Legion profiler behind
+``-lg:prof``.  This package is the TPU-native third channel the
+reference never had: a structured, machine-readable event log of the
+RUN itself — step spans, phase spans (compile / data-wait /
+metric-drain / checkpoint), throughput and MFU counters, search
+progress — written as JSONL so ``tools/trace_report.py`` can fold any
+run into a step-time/MFU breakdown after the fact (including a run a
+watchdog killed: records are line-buffered to disk as they happen).
+
+One flag lights up the whole stack: ``FF_TELEMETRY=1`` in the
+environment or ``FFConfig.telemetry = True``.  Disabled (the default),
+the hot path performs ZERO event-log calls — every site guards on a
+``None`` handle resolved once at ``compile()``.
+
+``events``    — the env/flag-gated structured event log (spans +
+                counters + gauges, thread-safe, JSONL sink).
+``stepstats`` — per-step instrumentation: wall time, first-step
+                compile time, samples/s/chip, analytic-FLOP MFU,
+                estimated collective bytes, device memory stats.
+"""
+
+from . import events
+from .events import EventLog, active_log, for_config
+
+__all__ = ["EventLog", "active_log", "events", "for_config"]

@@ -1,0 +1,157 @@
+"""Per-step training instrumentation.
+
+Computes, per ``update()``:
+
+  * wall time (host-side; in steady state the dispatch blocks on the
+    previous step's donated buffers, so wall-between-updates converges
+    on true device step time — set ``FF_TELEMETRY_SYNC=1`` to force a
+    ``model.sync()`` inside each timed step for exact-but-serialized
+    numbers),
+  * first-step wall time separately (jit trace + XLA compile happen
+    inside step 0 — the reference's epoch-0 Legion trace capture),
+  * samples/s and samples/s/chip,
+  * analytic-FLOP MFU: train FLOPs estimated as 3x the graph's forward
+    FLOPs (fwd + dgrad + wgrad — the same accounting bench.py and the
+    reference's backward multiplier use) against the machine model's
+    peak (``simulator/machine.py``, the calibrated numbers behind
+    ``simulator/cost_model.py``'s roofline),
+  * estimated per-step collective bytes from each op's RESOLVED
+    ``ParallelConfig`` (gradient all-reduce of replicated weights over
+    the batch axis + activation redistribution for non-batch splits),
+  * device memory stats when the backend reports them (TPU HBM
+    ``bytes_in_use`` / ``peak_bytes_in_use``; CPU reports none).
+
+Everything here is reached ONLY through a non-None EventLog resolved at
+``compile()`` — with telemetry off this module is never imported.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from .events import EventLog
+
+# Memory gauges are cheap but chatty; sample every N steps.
+MEM_GAUGE_EVERY = 8
+
+
+def estimate_collective_bytes(model) -> int:
+    """Rough per-step collective traffic implied by the resolved per-op
+    strategies.  Two terms, both analytic:
+
+      * gradient synchronization: weights replicated across a batch
+        degree d psum their grads — ring all-reduce moves
+        ``2 (d-1)/d * bytes`` per weight (f32 grads),
+      * activation redistribution: an output split on a non-batch dim
+        with degree d costs ~``(d-1)/d`` of the output's bytes at the
+        consumer boundary (allgather/reduce-scatter inserted by GSPMD).
+
+    Halo exchanges and resharding between mismatched consecutive
+    configs are NOT modeled — the simulator prices those; this is the
+    one-number health gauge.
+    """
+    dt_bytes = 2 if "16" in model.config.compute_dtype else 4
+    total = 0.0
+    for op in model.ops:
+        pc = getattr(op, "pc", None)
+        if pc is None or pc.host_placed:
+            continue
+        d0 = pc.dims[0]
+        if d0 > 1 and op.weights:
+            wbytes = sum(float(np.prod(w.dims)) for w in op.weights) * 4.0
+            total += 2.0 * (d0 - 1) / d0 * wbytes
+        obytes = float(np.prod(op.output.dims)) * dt_bytes
+        for d in pc.dims[1:]:
+            if d > 1:
+                total += (d - 1) / d * obytes
+    return int(total)
+
+
+def device_memory_stats() -> Optional[dict]:
+    """{"bytes_in_use", "peak_bytes_in_use"} when the backend exposes
+    allocator stats (TPU/GPU), else None (CPU)."""
+    try:
+        import jax
+
+        ms = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not ms:
+        return None
+    out = {}
+    for k in ("bytes_in_use", "peak_bytes_in_use"):
+        if k in ms:
+            out[k] = int(ms[k])
+    return out or None
+
+
+class StepStats:
+    """Times ``update()`` calls and folds the numbers into the event
+    log.  One instance per model, created at ``compile()`` when
+    telemetry is on."""
+
+    def __init__(self, model, log: EventLog):
+        self.model = model
+        self.log = log
+        self.steps = 0
+        self.sync_each_step = bool(os.environ.get("FF_TELEMETRY_SYNC"))
+        self._fwd_flops_per_sample: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+        self._collective_bytes: Optional[int] = None
+
+    # -- lazy statics (graph + machine are fixed after compile) ---------
+    def _statics(self):
+        if self._fwd_flops_per_sample is None:
+            self._fwd_flops_per_sample = float(
+                sum(op.flops_per_sample() for op in self.model.ops))
+            from ..simulator.machine import TPUMachineModel
+
+            nd = self.model.machine.num_devices if self.model.machine else 1
+            self._peak_flops = float(
+                TPUMachineModel.calibrated(num_devices=nd).peak_flops)
+            self._collective_bytes = estimate_collective_bytes(self.model)
+        return self._fwd_flops_per_sample, self._peak_flops
+
+    def timed_update(self, fn) -> None:
+        """Run one training step under a "step" span with throughput /
+        MFU counters."""
+        log = self.log
+        first = self.steps == 0
+        step_idx = self.model._step_count
+        t0 = time.perf_counter()
+        fn()
+        if self.sync_each_step:
+            self.model.sync()
+        dur = time.perf_counter() - t0
+        self.steps += 1
+
+        fwd_fps, peak = self._statics()
+        bs = self.model.config.batch_size
+        nd = self.model.machine.num_devices if self.model.machine else 1
+        sps = bs / dur if dur > 0 else 0.0
+        # fwd + dgrad + wgrad ~= 3x forward (reference backward accounting)
+        mfu = (3.0 * fwd_fps * sps / (nd * peak)) if peak else 0.0
+        log.span_at("step", t0, dur, step=step_idx, first=first,
+                    batch_size=bs,
+                    samples_per_sec=round(sps, 2),
+                    samples_per_sec_per_chip=round(sps / nd, 2),
+                    mfu=round(mfu, 6))
+        log.counter("samples", float(bs))
+        log.gauge("samples_per_sec", round(sps, 2))
+        log.gauge("samples_per_sec_per_chip", round(sps / nd, 2))
+        log.gauge("mfu", round(mfu, 6))
+        if first:
+            # step 0 wall includes jit trace + XLA compile
+            log.gauge("first_step_wall_s", round(dur, 6))
+            log.gauge("est_collective_bytes_per_step",
+                      float(self._collective_bytes))
+        if first or self.steps % MEM_GAUGE_EVERY == 0:
+            mem = device_memory_stats()
+            if mem:
+                for k, v in mem.items():
+                    log.gauge(f"device_{k}", float(v))
+        log.flush()

@@ -162,6 +162,16 @@ def _apply_tree(model, state: Dict[str, Any]) -> None:
 
 def save_checkpoint(model, path: str, force: bool = True) -> None:
     """Write the model's full training state to ``path`` (a directory)."""
+    tel = getattr(model, "_telemetry", None)
+    if tel is None:
+        return _save_checkpoint_impl(model, path, force)
+    with tel.span("checkpoint_save", path=path,
+                  step=getattr(model, "_step_count", 0)):
+        _save_checkpoint_impl(model, path, force)
+    tel.flush()
+
+
+def _save_checkpoint_impl(model, path: str, force: bool = True) -> None:
     # read barrier: an async host-table scatter-back may be in flight
     getattr(model, "_he_join", lambda: None)()
     if path.endswith(".npz"):
@@ -180,6 +190,15 @@ def save_checkpoint(model, path: str, force: bool = True) -> None:
 def load_checkpoint(model, path: str) -> None:
     """Restore training state saved by save_checkpoint, re-sharded onto
     the model's current mesh."""
+    tel = getattr(model, "_telemetry", None)
+    if tel is None:
+        return _load_checkpoint_impl(model, path)
+    with tel.span("checkpoint_restore", path=path):
+        _load_checkpoint_impl(model, path)
+    tel.flush()
+
+
+def _load_checkpoint_impl(model, path: str) -> None:
     # an in-flight scatter-back would race the restored tables
     getattr(model, "_he_join", lambda: None)()
     if os.path.isfile(path) or path.endswith(".npz"):

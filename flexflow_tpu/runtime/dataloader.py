@@ -105,6 +105,20 @@ class DataLoader:
 
     def next_batch(self, ff=None) -> None:
         ff = ff or self.ff
+        tel = getattr(ff, "_telemetry", None)
+        if tel is None:
+            return self._next_batch_impl(ff)
+        # "data_wait" = everything the step blocks on for input: the host
+        # gather (~0 when the prefetch worker already has it) plus the
+        # sharded device_put inside set_batch.
+        with tel.span("data_wait", batch_size=self.batch_size) as at:
+            at["prefetched"] = (
+                self._pending is not None
+                and self._pending[0] == self._start_of(self.next_index)
+                and self._pending[1] == self._order_version)
+            self._next_batch_impl(ff)
+
+    def _next_batch_impl(self, ff) -> None:
         start = self._start_of(self.next_index)
         batch = None
         if self._pending is not None:

@@ -59,6 +59,14 @@ def op_profile(model, which: str = "both") -> Dict[str, Dict[str, float]]:
         if which in ("both", "backward"):
             entry["backward_ms"] = cm.op_time(op, pc, "backward") * 1e3
         out[op.name] = entry
+    tel = getattr(model, "_telemetry", None)
+    if tel is not None:
+        # one event per op: trace_report folds these into its top-k table
+        for name, t in out.items():
+            tel.event("op_profile", op=name,
+                      forward_ms=round(t.get("forward_ms", 0.0), 4),
+                      backward_ms=round(t.get("backward_ms", 0.0), 4))
+        tel.flush()
     return out
 
 

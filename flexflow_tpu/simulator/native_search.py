@@ -277,6 +277,11 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
     a_choice_init = _as(choice_init, np.int32)
     a_choice_out = _as(choice_out, np.int32)
 
+    import time as _time
+
+    from ..observability.events import active_log
+    tel = active_log()
+    anneal_t0 = _time.perf_counter()
     best_rt = lib.ffsearch_anneal(
         mm.num_devices, mm.chips_per_host, mm.torus[0], mm.torus[1],
         mm.ici_bandwidth, mm.dcn_bandwidth, cost._dtype_bytes,
@@ -299,6 +304,15 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
 
     best = {op.name: cand_lists[i][int(a_choice_out[i])]
             for i, op in enumerate(ops)}
+    if tel is not None:
+        # the C engine owns the loop, so the span covers the whole anneal
+        # and the end event carries its summary numbers
+        tel.span_at("native_search", anneal_t0,
+                    _time.perf_counter() - anneal_t0,
+                    budget=budget, candidates=int(cand_off[-1]),
+                    dp_ms=round(dp_rt.value * 1e3, 3),
+                    best_ms=round(float(best_rt) * 1e3, 3))
+        tel.flush()
     if verbose:
         print(f"native search: dp {dp_rt.value * 1e3:.3f} ms/iter -> "
               f"best {best_rt * 1e3:.3f} ms/iter over {cand_off[-1]} "

@@ -134,23 +134,39 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
     current_rt = sim.simulate_runtime(model, current)
     best, best_rt = dict(current), current_rt
 
-    for it in range(budget):
-        op = rng.choice(model.ops)
-        nxt = dict(current)
-        # Legalize through the op hook so configs whose dims carry
-        # non-size meaning (PipelineMLP pipe degree) are clamped against
-        # the real bound before costing (same as the native engine path).
-        nxt[op.name] = op.legalize_pc(
-            random_parallel_config(op, nd, rng, model=model))
-        nxt_rt = sim.simulate_runtime(model, nxt)
-        if verbose and it % 100 == 0:
-            print(f"iter({it}) cur({current_rt * 1e3:.3f}ms) "
-                  f"next({nxt_rt * 1e3:.3f}ms) best({best_rt * 1e3:.3f}ms)")
-        if nxt_rt < best_rt:
-            best_rt, best = nxt_rt, dict(nxt)
-        if nxt_rt < current_rt or rng.random() < math.exp(
-                -alpha * (nxt_rt - current_rt) * 1e3):
-            current, current_rt = nxt, nxt_rt
+    import contextlib
+
+    from ..observability.events import active_log
+    tel = active_log()
+    span = tel.span("mcmc_search", budget=budget, num_devices=nd) \
+        if tel is not None else contextlib.nullcontext({})
+    with span as span_attrs:
+        for it in range(budget):
+            op = rng.choice(model.ops)
+            nxt = dict(current)
+            # Legalize through the op hook so configs whose dims carry
+            # non-size meaning (PipelineMLP pipe degree) are clamped
+            # against the real bound before costing (same as the native
+            # engine path).
+            nxt[op.name] = op.legalize_pc(
+                random_parallel_config(op, nd, rng, model=model))
+            nxt_rt = sim.simulate_runtime(model, nxt)
+            if it % 100 == 0:
+                if verbose:
+                    print(f"iter({it}) cur({current_rt * 1e3:.3f}ms) "
+                          f"next({nxt_rt * 1e3:.3f}ms) "
+                          f"best({best_rt * 1e3:.3f}ms)")
+                if tel is not None:
+                    tel.event("search_progress", engine="mcmc", iter=it,
+                              best_ms=round(best_rt * 1e3, 3))
+            if nxt_rt < best_rt:
+                best_rt, best = nxt_rt, dict(nxt)
+            if nxt_rt < current_rt or rng.random() < math.exp(
+                    -alpha * (nxt_rt - current_rt) * 1e3):
+                current, current_rt = nxt, nxt_rt
+        span_attrs["best_ms"] = round(best_rt * 1e3, 3)
+    if tel is not None:
+        tel.flush()
     if verbose:
         print("=========== Best Discovered Strategy ==========")
         for name, pc in best.items():

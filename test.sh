@@ -20,6 +20,17 @@ else
   python -m pytest tests/ -q -m "not slow" "$@"
 fi
 
+# Telemetry smoke: a 2-step tiny training run must produce a readable
+# trace and trace_report must fold it into a non-empty report
+# (docs/observability.md).
+TRACE=$(mktemp -d)/smoke.jsonl
+FF_TELEMETRY=1 FF_TELEMETRY_FILE="$TRACE" \
+  python examples/alexnet.py -b 8 --iterations 2 -e 1 > /dev/null
+REPORT=$(python -m flexflow_tpu.tools.trace_report "$TRACE")
+echo "$REPORT" | grep -q "## Steps" \
+  || { echo "telemetry smoke: report missing step section"; exit 1; }
+echo "telemetry smoke: OK ($(wc -l < "$TRACE") trace records)"
+
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \
             examples/keras/seq_mnist_mlp.py \
