@@ -120,6 +120,20 @@ def _write_side_file():
         pass
 
 
+def _heartbeat_detail():
+    """Fine-grained wedge location from the FF_HEARTBEAT_PATH file
+    (observability/health.py protocol): the framework rewrites it at
+    every phase entry and step, so the kill message can say
+    "phase 'step' (step 12, 95s stale)" instead of just the bench
+    phase.  Returns None when unavailable — never raises."""
+    try:
+        from flexflow_tpu.observability import health
+
+        return health.describe_heartbeat(health.read_heartbeat())
+    except Exception:
+        return None
+
+
 def _watchdog():
     while True:
         time.sleep(2.0)
@@ -131,16 +145,18 @@ def _watchdog():
                 continue
             why = ("global budget" if over_global else
                    f"phase '{_state['phase']}' budget")
+            hb = _heartbeat_detail()
+            where = _state["phase"] + (f" at {hb}" if hb else "")
             if not _state["primary_printed"]:
-                _state["extra"]["watchdog"] = f"killed in {_state['phase']}"
+                _state["extra"]["watchdog"] = f"killed in {where}"
                 _emit_primary(None, _state["extra"],
-                              error=f"watchdog: {why} exceeded "
+                              error=f"watchdog: {why} exceeded in {where} "
                                     f"(TPU tunnel wedged?)")
                 _write_side_file()
                 os._exit(1)
             # primary already on stdout: preserve it, record what died
             _state["extra"]["watchdog"] = (
-                f"{why} exceeded during '{_state['phase']}'")
+                f"{why} exceeded during '{where}'")
             _write_side_file()
             os._exit(0)
 
@@ -159,8 +175,11 @@ def _telemetry_heartbeat(phase):
     line-buffered, so the record survives the watchdog's os._exit.
     Never lets telemetry break the bench."""
     try:
-        from flexflow_tpu.observability import events
+        from flexflow_tpu.observability import events, health
 
+        # heartbeat file too (independent of FF_TELEMETRY): the
+        # watchdog's kill message names the last phase written here
+        health.write_heartbeat(phase)
         log = events.active_log()
         if log is not None:
             log.event("bench_phase", phase=phase)
@@ -439,20 +458,26 @@ def profile(out="/tmp/flexflow_tpu_trace"):
     print(f"-> trace in {out} (tensorboard --logdir {out})")
 
 
+def _flag_path(flag, default):
+    """Optional path operand after ``flag``: only consume the next argv
+    token when it isn't itself a flag (``--sweep --profile`` must not
+    write a file literally named ``--profile``)."""
+    idx = sys.argv.index(flag)
+    nxt = sys.argv[idx + 1] if len(sys.argv) > idx + 1 else None
+    return nxt if nxt and not nxt.startswith("-") else default
+
+
 def main():
     if "--sweep" in sys.argv:
-        idx = sys.argv.index("--sweep")
-        out = (sys.argv[idx + 1] if len(sys.argv) > idx + 1
-               else "BENCH_SWEEP.md")
-        sweep(out)
+        sweep(_flag_path("--sweep", "BENCH_SWEEP.md"))
         return
     if "--profile" in sys.argv:
-        idx = sys.argv.index("--profile")
-        out = (sys.argv[idx + 1] if len(sys.argv) > idx + 1
-               else "/tmp/flexflow_tpu_trace")
-        profile(out)
+        profile(_flag_path("--profile", "/tmp/flexflow_tpu_trace"))
         return
 
+    # Heartbeat file for phase-level wedge attribution (the framework
+    # rewrites it at every phase entry / step; the watchdog reads it).
+    os.environ.setdefault("FF_HEARTBEAT_PATH", "BENCH_HEARTBEAT.json")
     threading.Thread(target=_watchdog, daemon=True).start()
     # initial phase is set at module load, not via _enter_phase — emit
     # its heartbeat here (stdlib-only module: safe before jax init)

@@ -1,0 +1,20 @@
+"""Shims over jax API drift.
+
+``jax.shard_map`` (with its ``check_vma`` replication knob) landed in
+jax 0.6; older installs keep the same callable at
+``jax.experimental.shard_map.shard_map`` where the knob is named
+``check_rep``.  Every shard_map site in the framework imports from here
+so both spellings of the install work.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)

@@ -128,6 +128,12 @@ class FFModel:
         # check and makes zero event-log calls.
         self._telemetry = None
         self._stepstats = None
+        # Health monitor (observability/health.py): non-None only when
+        # FF_HEALTH rides an enabled telemetry log.
+        self._health = None
+        # Simulator's predicted step seconds (observability/agreement.py,
+        # set post-compile under telemetry) for sim_divergence events.
+        self._predicted_step_s = None
 
     # ------------------------------------------------------------------
     # graph construction
@@ -772,10 +778,16 @@ class FFModel:
         set — so every later step guards on a plain ``None`` handle.
         """
         from .observability import events as _ff_events
+        from .observability import health as _ff_health
 
+        # Heartbeat is independent of telemetry (stdlib; no-op unless
+        # FF_HEARTBEAT_PATH is set): an external watchdog can name a
+        # wedged compile even on an untraced run.
+        _ff_health.write_heartbeat("compile")
         self._telemetry = _ff_events.for_config(self.config)
         if self._telemetry is None:
             self._stepstats = None
+            self._health = None
             return self._compile_impl(optimizer, loss_type, metrics, machine)
         with self._telemetry.span("compile", num_ops=len(self.ops)) as at:
             self._compile_impl(optimizer, loss_type, metrics, machine)
@@ -784,6 +796,14 @@ class FFModel:
         from .observability.stepstats import StepStats
 
         self._stepstats = StepStats(self, self._telemetry)
+        if _ff_health.enabled():
+            self._health = _ff_health.HealthMonitor(self, self._telemetry)
+            self._telemetry.add_observer(self._health.observe)
+        else:
+            self._health = None
+        from .observability import agreement as _ff_agreement
+
+        _ff_agreement.emit_compile_prediction(self, self._telemetry)
         self._telemetry.flush()
 
     def _compile_impl(self, optimizer=None,
@@ -1668,6 +1688,24 @@ class FFModel:
 
         accum = max(1, int(self.config.grad_accum_steps))
 
+        track_health = self._health is not None
+
+        def health_metrics(loss, grads):
+            # Device-side isfinite reduction over the loss and the
+            # global grad-norm, folded into the metric vector — fetched
+            # by the existing drain, no extra dispatches.
+            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(gsq)
+            vec = jnp.zeros((len(mkeys),), jnp.float32)
+            vec = vec.at[mkeys.index("nonfinite_loss")].set(
+                1.0 - jnp.isfinite(loss).astype(jnp.float32))
+            vec = vec.at[mkeys.index("nonfinite_grad")].set(
+                1.0 - jnp.isfinite(gnorm).astype(jnp.float32))
+            vec = vec.at[mkeys.index("grad_norm")].set(
+                jnp.where(jnp.isfinite(gnorm), gnorm, 0.0))
+            return vec
+
         def micro_metrics(loss, probs, labels):
             msum = metrics.compute(probs, labels)
             msum["loss"] = loss
@@ -1690,6 +1728,8 @@ class FFModel:
             (loss, (probs, new_stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             mvec = micro_metrics(loss, probs, labels)
+            if track_health:
+                mvec = mvec + health_metrics(loss, grads)
             new_params, new_opt = opt.apply(params, grads, opt_state, hparams)
             return new_params, new_stats, new_opt, macc + mvec
 
@@ -1732,6 +1772,11 @@ class FFModel:
                 if name in mkeys:
                     fix = fix.at[mkeys.index(name)].set(1.0 / accum)
             mvec = mvec * fix
+            if track_health:
+                # accumulated grads; the mean micro loss rides mvec and
+                # is NaN iff any micro's loss was
+                mvec = mvec + health_metrics(
+                    mvec[mkeys.index("loss")], grads)
             new_params, new_opt = opt.apply(params, grads, opt_state, hparams)
             return new_params, new_stats, new_opt, macc + mvec
 
@@ -1801,8 +1846,15 @@ class FFModel:
         self._staged = True
 
     def _metric_keys(self) -> List[str]:
-        return ["train_all", "train_correct", "cce_loss", "sparse_cce_loss",
+        keys = ["train_all", "train_correct", "cce_loss", "sparse_cce_loss",
                 "mse_loss", "rmse_loss", "mae_loss", "loss", "steps"]
+        if self._health is not None:
+            # Health entries ride the same on-device vector (non-finite
+            # loss/grad counts + summed grad norm) so detection costs
+            # zero extra dispatches; the drain pops them before
+            # PerfMetrics sees the dict.
+            keys += list(self._health.METRIC_KEYS)
+        return keys
 
     def update(self) -> None:
         # _stepstats is non-None only under telemetry; the disabled path
@@ -2299,6 +2351,10 @@ class FFModel:
             loss_sum = totals.pop("loss", None)
             if steps > 0 and loss_sum is not None:
                 self.last_loss = loss_sum / steps  # mean loss since last drain
+            if self._health is not None:
+                health_vals = {k: totals.pop(k) for k in
+                               self._health.METRIC_KEYS if k in totals}
+                self._health.on_drain(health_vals, steps, self._step_count)
             self.current_metrics.update(totals)
             self._metric_acc = jnp.zeros_like(self._metric_acc)
 

@@ -20,9 +20,18 @@ the hot path performs ZERO event-log calls — every site guards on a
 ``stepstats`` — per-step instrumentation: wall time, first-step
                 compile time, samples/s/chip, analytic-FLOP MFU,
                 estimated collective bytes, device memory stats.
+``health``    — ``FF_HEALTH=1`` live monitor on top of the log:
+                non-finite loss/grad sampling, straggler detection
+                with phase attribution, data-starvation warnings, and
+                the ``FF_HEARTBEAT_PATH`` heartbeat file protocol.
+``agreement`` — continuous simulator validation: predicted per-op /
+                per-step times diffed against measured walls as
+                ``sim_prediction`` / ``sim_divergence`` events.
 """
 
-from . import events
+from . import events, health
 from .events import EventLog, active_log, for_config
+from .health import HealthMonitor, read_heartbeat, write_heartbeat
 
-__all__ = ["EventLog", "active_log", "events", "for_config"]
+__all__ = ["EventLog", "HealthMonitor", "active_log", "events",
+           "for_config", "health", "read_heartbeat", "write_heartbeat"]

@@ -69,11 +69,26 @@ class EventLog:
         # Running per-counter totals (survive into truncated traces via
         # the per-record "total" field; tests assert aggregation here).
         self.totals: Dict[str, float] = {}
+        # Observers see every written record (observability/health.py
+        # taps spans for straggler attribution).  Called OUTSIDE the
+        # lock: an observer may emit records of its own.
+        self._observers: list = []
 
     # -- clock ----------------------------------------------------------
     def now(self) -> float:
         """Seconds since log creation (monotonic)."""
         return self._clock() - self._t0
+
+    def to_rel(self, t: float) -> float:
+        """Convert a raw clock reading (``time.perf_counter()`` with the
+        default clock) into the log's relative time domain."""
+        return t - self._t0
+
+    # -- observers ------------------------------------------------------
+    def add_observer(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
 
     # -- sink -----------------------------------------------------------
     def _write(self, rec: Dict[str, Any]) -> None:
@@ -92,6 +107,12 @@ class EventLog:
                      "run_id": self.run_id, "pid": os.getpid(),
                      "unix_time": time.time()}) + "\n")
             self._file.write(json.dumps(rec) + "\n")
+            observers = tuple(self._observers)
+        for fn in observers:
+            try:
+                fn(rec)
+            except Exception:
+                pass  # observers never break the sink
 
     def flush(self) -> None:
         with self._lock:

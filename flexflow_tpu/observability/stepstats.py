@@ -34,6 +34,7 @@ from typing import Optional
 import numpy as np
 
 from .events import EventLog
+from .health import write_heartbeat
 
 # Memory gauges are cheap but chatty; sample every N steps.
 MEM_GAUGE_EVERY = 8
@@ -122,6 +123,9 @@ class StepStats:
         log = self.log
         first = self.steps == 0
         step_idx = self.model._step_count
+        # Heartbeat BEFORE dispatch: a wedged step leaves "step" (with
+        # its index) on disk for the external watchdog to name.
+        write_heartbeat("step", step=step_idx)
         t0 = time.perf_counter()
         fn()
         if self.sync_each_step:
@@ -155,3 +159,6 @@ class StepStats:
                 for k, v in mem.items():
                     log.gauge(f"device_{k}", float(v))
         log.flush()
+        health = getattr(self.model, "_health", None)
+        if health is not None:
+            health.on_step(step_idx, log.to_rel(t0), dur, first)

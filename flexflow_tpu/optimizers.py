@@ -112,11 +112,13 @@ class Optimizer:
             return fn
         from jax.sharding import PartitionSpec
 
+        from .compat import shard_map
+
         scalar = PartitionSpec()
         in_specs = tuple([scalar] + [spec] * n_in)
         out_specs = tuple([spec] * n_out) if n_out > 1 else spec
-        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+        return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
 
     def init_state(self, params: Params) -> OptState:
         raise NotImplementedError

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
-from jax import shard_map
+
+from ..compat import shard_map
 
 
 def sequential_stages(stage_fn: Callable, stage_params, x):

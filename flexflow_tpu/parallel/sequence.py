@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-from jax import shard_map
+from ..compat import shard_map
 
 from ..kernels.flash_attention import flash_attention, NEG_INF
 

@@ -162,6 +162,12 @@ def _apply_tree(model, state: Dict[str, Any]) -> None:
 
 def save_checkpoint(model, path: str, force: bool = True) -> None:
     """Write the model's full training state to ``path`` (a directory)."""
+    from ..observability.health import write_heartbeat
+
+    # no-op unless FF_HEARTBEAT_PATH is set: a wedged save gets named
+    # by the external watchdog
+    write_heartbeat("checkpoint_save",
+                    step=getattr(model, "_step_count", 0))
     tel = getattr(model, "_telemetry", None)
     if tel is None:
         return _save_checkpoint_impl(model, path, force)
@@ -190,6 +196,9 @@ def _save_checkpoint_impl(model, path: str, force: bool = True) -> None:
 def load_checkpoint(model, path: str) -> None:
     """Restore training state saved by save_checkpoint, re-sharded onto
     the model's current mesh."""
+    from ..observability.health import write_heartbeat
+
+    write_heartbeat("checkpoint_restore")
     tel = getattr(model, "_telemetry", None)
     if tel is None:
         return _load_checkpoint_impl(model, path)

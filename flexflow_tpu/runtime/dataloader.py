@@ -105,6 +105,11 @@ class DataLoader:
 
     def next_batch(self, ff=None) -> None:
         ff = ff or self.ff
+        # Heartbeat BEFORE the gather (no-op unless FF_HEARTBEAT_PATH is
+        # set): a wedged input pipeline gets named by the watchdog.
+        from ..observability.health import write_heartbeat
+
+        write_heartbeat("data_wait", step=getattr(ff, "_step_count", None))
         tel = getattr(ff, "_telemetry", None)
         if tel is None:
             return self._next_batch_impl(ff)

@@ -61,11 +61,27 @@ def op_profile(model, which: str = "both") -> Dict[str, Dict[str, float]]:
         out[op.name] = entry
     tel = getattr(model, "_telemetry", None)
     if tel is not None:
+        from ..observability import agreement
+
+        # the NON-measuring cost model's price for the same shapes —
+        # the simulator-agreement side of each measured wall
+        try:
+            predicted = agreement.predict_op_times(model)
+        except Exception:
+            predicted = {}
         # one event per op: trace_report folds these into its top-k table
         for name, t in out.items():
             tel.event("op_profile", op=name,
                       forward_ms=round(t.get("forward_ms", 0.0), 4),
                       backward_ms=round(t.get("backward_ms", 0.0), 4))
+            pred = predicted.get(name)
+            if not pred:
+                continue
+            for w in ("forward", "backward"):
+                if f"{w}_ms" in t:
+                    agreement.emit_op_divergence(
+                        tel, name, w, pred[f"{w}_ms"], t[f"{w}_ms"],
+                        src=pred.get(f"{w}_src", "analytic"))
         tel.flush()
     return out
 

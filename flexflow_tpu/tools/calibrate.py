@@ -472,10 +472,11 @@ def build_job_list(cost, devices: int, alexnet_batch: int, bench_batch: int,
     # ~654 jobs, and these are the ones that raise each report's
     # measured-provenance count instead of landing at random.  Both
     # partitions stay cheapest-analytic-first.
-    try:
-        from .report_configs import report_keys_path
+    from .report_configs import report_keys_path
 
-        with open(report_keys_path()) as f:
+    keys_path = report_keys_path()
+    try:
+        with open(keys_path) as f:
             raw = json.load(f)
         # entries are {"devices": N, "batch": B, "keys": [...]} (legacy
         # plain lists accepted, scale assumed canonical)
@@ -484,7 +485,9 @@ def build_job_list(cost, devices: int, alexnet_batch: int, bench_batch: int,
                    {"devices": REPORT_DEVICES.get(name), "batch": None,
                     "keys": e})
             for name, e in raw.items()}
-    except Exception:
+    except Exception as e:
+        print(f"[calibrate] no report-key priority hints ({keys_path}: "
+              f"{e!r}) — job order falls back to cheapest-analytic-first")
         keys_by_model = {}
     if keys_by_model:
         # Models whose report scale is not enumerated above (either not

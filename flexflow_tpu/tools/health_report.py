@@ -1,0 +1,214 @@
+"""Fold a telemetry JSONL trace into a training-health report.
+
+Companion to ``trace_report.py`` (which answers "how fast was it"):
+this CLI answers "was it healthy, and does reality match the
+simulator".  Sections:
+
+  * health findings (``health`` events from observability/health.py:
+    non-finite loss/grad, stragglers with phase attribution, data
+    starvation), aggregated by kind,
+  * step health: steady-state p50/p95 plus the straggler count,
+  * data pipeline: cumulative data_wait vs step time,
+  * simulator agreement: step-level predicted-vs-measured and the
+    per-op table from ``sim_divergence`` events (ratio per op/dir,
+    worst-case band) — rows slot into CALIBRATION.md's multi-point
+    validation table,
+  * last heartbeat / bench phase seen in the trace.
+
+STDLIB-ONLY: a pod trace must be foldable on any laptop.
+
+Usage:
+    python -m flexflow_tpu.tools.health_report ff_trace.jsonl
+    python -m flexflow_tpu.tools.health_report ff_trace.jsonl -o health.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional
+
+from .trace_report import parse_trace, percentile
+
+
+def _fmt_attrs(attrs: Dict[str, Any], skip=("kind",)) -> str:
+    return " ".join(f"{k}={attrs[k]}" for k in sorted(attrs)
+                    if k not in skip)
+
+
+def _collect(records: List[Dict[str, Any]]):
+    spans: Dict[str, List[Dict[str, Any]]] = {}
+    events: Dict[str, List[Dict[str, Any]]] = {}
+    meta: Dict[str, Any] = {}
+    for r in records:
+        t = r.get("t")
+        if t == "span":
+            spans.setdefault(r.get("name", "?"), []).append(r)
+        elif t == "event":
+            events.setdefault(r.get("name", "?"), []).append(r)
+        elif t == "meta":
+            meta = r
+    return spans, events, meta
+
+
+def render_report(records: List[Dict[str, Any]]) -> str:
+    spans, events, meta = _collect(records)
+    lines = ["# flexflow_tpu health report", ""]
+    if meta:
+        lines.append(f"run `{meta.get('run_id', '?')}` · pid "
+                     f"{meta.get('pid', '?')} · {len(records)} records")
+        lines.append("")
+
+    # ---- health findings ---------------------------------------------
+    health = events.get("health", [])
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for e in health:
+        by_kind.setdefault(e.get("attrs", {}).get("kind", "?"), []).append(e)
+    lines.append("## Health findings")
+    lines.append("")
+    if by_kind:
+        lines.append("| kind | count | first ts s | last ts s | last detail |")
+        lines.append("|---|---|---|---|---|")
+        for kind in sorted(by_kind):
+            es = by_kind[kind]
+            lines.append(
+                f"| {kind} | {len(es)} | {float(es[0].get('ts', 0.0)):.2f} | "
+                f"{float(es[-1].get('ts', 0.0)):.2f} | "
+                f"{_fmt_attrs(es[-1].get('attrs', {}))} |")
+    else:
+        lines.append("_no health findings — run looks clean_")
+    lines.append("")
+
+    # ---- step health --------------------------------------------------
+    steps = sorted(spans.get("step", []), key=lambda s: s.get("ts", 0.0))
+    steady = [s for s in steps if not s.get("attrs", {}).get("first")]
+    measured_p50_ms: Optional[float] = None
+    if steady:
+        durs = sorted(float(s.get("dur", 0.0)) for s in steady)
+        measured_p50_ms = percentile(durs, 50) * 1e3
+        lines.append("## Step health")
+        lines.append("")
+        lines.append(f"- steady-state over {len(durs)} steps: "
+                     f"p50 {measured_p50_ms:.1f} ms · "
+                     f"p95 {percentile(durs, 95) * 1e3:.1f} ms")
+        stragglers = by_kind.get("straggler", [])
+        if stragglers:
+            worst = max(float(e.get("attrs", {}).get("ratio", 0.0))
+                        for e in stragglers)
+            lines.append(f"- stragglers flagged: {len(stragglers)} "
+                         f"(worst {worst:.1f}x p50)")
+        else:
+            lines.append("- stragglers flagged: 0")
+        lines.append("")
+
+    # ---- data pipeline ------------------------------------------------
+    waits = spans.get("data_wait", [])
+    if waits and steady:
+        wait_s = sum(float(s.get("dur", 0.0)) for s in waits)
+        step_s = sum(float(s.get("dur", 0.0)) for s in steady)
+        lines.append("## Data pipeline")
+        lines.append("")
+        ratio = wait_s / step_s if step_s > 0 else 0.0
+        lines.append(f"- data_wait total {wait_s:.3f} s over {len(waits)} "
+                     f"batches · wait/step ratio {100 * ratio:.1f}%")
+        lines.append("")
+
+    # ---- simulator agreement ------------------------------------------
+    divs = events.get("sim_divergence", [])
+    preds = events.get("sim_prediction", [])
+    step_divs = [e for e in divs
+                 if e.get("attrs", {}).get("scope") == "step"]
+    # latest row per (op, which) wins — op_profile may rerun
+    op_rows: Dict[tuple, Dict[str, Any]] = {}
+    for e in divs:
+        a = e.get("attrs", {})
+        if a.get("scope") == "op":
+            op_rows[(a.get("op", "?"), a.get("which", "?"))] = a
+    if step_divs or preds or op_rows:
+        lines.append("## Simulator agreement (predicted vs measured)")
+        lines.append("")
+        if step_divs:
+            a = step_divs[-1].get("attrs", {})
+            lines.append(f"- step: predicted "
+                         f"{float(a.get('predicted_ms', 0.0)):.3f} ms · "
+                         f"measured p50 "
+                         f"{float(a.get('measured_ms', 0.0)):.3f} ms · "
+                         f"ratio {float(a.get('ratio', 0.0)):.2f} "
+                         f"(over {a.get('n_steps', '?')} steps)")
+        elif preds and measured_p50_ms:
+            # no health monitor in the run: derive the step-level row
+            # from the compile-time prediction + the step spans
+            p = float(preds[-1].get("attrs", {}).get("predicted_step_ms", 0.0))
+            if p > 0:
+                lines.append(f"- step: predicted {p:.3f} ms · measured p50 "
+                             f"{measured_p50_ms:.3f} ms · ratio "
+                             f"{p / measured_p50_ms:.2f}")
+        elif preds:
+            p = float(preds[-1].get("attrs", {}).get("predicted_step_ms", 0.0))
+            lines.append(f"- step: predicted {p:.3f} ms · no measured steps "
+                         f"in trace")
+        if op_rows:
+            lines.append("")
+            lines.append("| op | dir | predicted ms | measured ms | ratio "
+                         "| source |")
+            lines.append("|---|---|---|---|---|---|")
+            worst_key, worst_off = None, 0.0
+            ratios = []
+            for key in sorted(op_rows):
+                a = op_rows[key]
+                r = float(a.get("ratio", 0.0))
+                if r > 0:
+                    ratios.append(r)
+                    off = max(r, 1.0 / r)
+                    if off > worst_off:
+                        worst_key, worst_off = key, off
+                lines.append(
+                    f"| {key[0]} | {key[1]} | "
+                    f"{float(a.get('predicted_ms', 0.0)):.3f} | "
+                    f"{float(a.get('measured_ms', 0.0)):.3f} | "
+                    f"{r:.2f} | {a.get('src', '?')} |")
+            if ratios:
+                lines.append("")
+                lines.append(f"- per-op ratio band: {min(ratios):.2f}x – "
+                             f"{max(ratios):.2f}x over {len(ratios)} rows")
+                if worst_key is not None:
+                    lines.append(f"- worst-case ratio: {worst_off:.2f}x off "
+                                 f"({worst_key[0]} {worst_key[1]})")
+        lines.append("")
+
+    # ---- heartbeat / phases -------------------------------------------
+    bench = events.get("bench_phase", [])
+    if bench:
+        last = bench[-1]
+        lines.append("## Last phase")
+        lines.append("")
+        lines.append(f"- bench phase `{last.get('attrs', {}).get('phase', '?')}`"
+                     f" at ts {float(last.get('ts', 0.0)):.2f} s")
+        lines.append("")
+
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> str:
+    p = argparse.ArgumentParser(
+        description="Fold a flexflow_tpu telemetry trace into a health + "
+                    "simulator-agreement report.")
+    p.add_argument("trace", help="path to the JSONL trace "
+                                 "(FF_TELEMETRY_FILE / ff_trace.jsonl)")
+    p.add_argument("-o", "--out", default=None,
+                   help="write report to this file instead of stdout")
+    args = p.parse_args(argv)
+
+    records = parse_trace(args.trace)
+    report = render_report(records)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"{len(records)} records -> {args.out}")
+    else:
+        sys.stdout.write(report)
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,11 @@ REPORT_DEVICES = {
 # simulated-vs-measured agreement config
 BENCH_SINGLE_CHIP_BATCH = 256
 
+# Compute dtype the committed reports (and their measured-cache keys /
+# priority hints) are priced in — part of soap_report's canonical-scale
+# guard: a float32 run must not clobber the bfloat16 hint keys.
+REPORT_COMPUTE_DTYPE = "bfloat16"
+
 # A roofline fit from fewer points / op families than this extrapolates
 # beyond its basis; calibrate warns and the reports disclose it.
 THIN_FIT_POINTS = 16

@@ -41,7 +41,8 @@ def main(argv: Optional[List[str]] = None):
                    help="independent annealing restarts (seeds seed.."
                         "seed+N-1); the best plan is kept (default: "
                         "report_configs.SEARCH_RESTARTS)")
-    p.add_argument("--compute-dtype", default="bfloat16")
+    from .report_configs import REPORT_COMPUTE_DTYPE
+    p.add_argument("--compute-dtype", default=REPORT_COMPUTE_DTYPE)
     p.add_argument("--export", default=None)
     p.add_argument("--out", default="REPORT_SOAP.md")
     p.add_argument("--measured-single-chip-ms", type=float, default=None,
@@ -177,11 +178,16 @@ def main(argv: Optional[List[str]] = None):
     try:
         import os
 
-        from .report_configs import REPORT_DEVICES, report_keys_path
+        from .report_configs import (REPORT_COMPUTE_DTYPE, REPORT_DEVICES,
+                                     report_keys_path)
 
+        # scale AND dtype must match the committed reports: measured
+        # cache keys are dtype-tagged, so a float32 run at canonical
+        # scale would publish keys calibrate can never match
         canonical = (args.devices == REPORT_DEVICES.get(args.model)
                      and args.batch_size
-                     == REPORT_GLOBAL_BATCH.get(args.model))
+                     == REPORT_GLOBAL_BATCH.get(args.model)
+                     and args.compute_dtype == REPORT_COMPUTE_DTYPE)
         if canonical:
             keys_path = report_keys_path()
             try:

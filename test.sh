@@ -20,16 +20,23 @@ else
   python -m pytest tests/ -q -m "not slow" "$@"
 fi
 
-# Telemetry smoke: a 2-step tiny training run must produce a readable
-# trace and trace_report must fold it into a non-empty report
-# (docs/observability.md).
-TRACE=$(mktemp -d)/smoke.jsonl
+# Telemetry smoke: a 2-step tiny training run under FF_TELEMETRY +
+# FF_HEALTH must produce a readable trace, a heartbeat file, and both
+# reports must fold it (docs/observability.md).
+SMOKE_DIR=$(mktemp -d)
+TRACE="$SMOKE_DIR/smoke.jsonl"
+HEARTBEAT="$SMOKE_DIR/hb.json"
 FF_TELEMETRY=1 FF_TELEMETRY_FILE="$TRACE" \
+  FF_HEALTH=1 FF_HEARTBEAT_PATH="$HEARTBEAT" \
   python examples/alexnet.py -b 8 --iterations 2 -e 1 > /dev/null
 REPORT=$(python -m flexflow_tpu.tools.trace_report "$TRACE")
 echo "$REPORT" | grep -q "## Steps" \
   || { echo "telemetry smoke: report missing step section"; exit 1; }
-echo "telemetry smoke: OK ($(wc -l < "$TRACE") trace records)"
+python -m flexflow_tpu.tools.health_report "$TRACE" > /dev/null \
+  || { echo "health smoke: health_report failed"; exit 1; }
+grep -q '"phase"' "$HEARTBEAT" \
+  || { echo "health smoke: heartbeat file missing/empty"; exit 1; }
+echo "telemetry+health smoke: OK ($(wc -l < "$TRACE") trace records)"
 
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \

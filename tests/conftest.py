@@ -7,6 +7,7 @@ strategy space is exercised in CI with no TPU attached.
 """
 
 import os
+import tempfile
 
 # Must be set before the XLA CPU client initializes.
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -15,8 +16,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 # Tests invoking soap_report (any config) must not overwrite the repo's
 # committed calibration-priority hints (flexflow_tpu/simulator/
-# report_keys.json) with their tiny test configs.
-os.environ.setdefault("FF_REPORT_KEYS_PATH", "/tmp/ff_test_report_keys.json")
+# report_keys.json) with their tiny test configs.  Per-session temp dir:
+# concurrent suites (or stale files from another user) must not share
+# one fixed /tmp path.
+os.environ.setdefault(
+    "FF_REPORT_KEYS_PATH",
+    os.path.join(tempfile.mkdtemp(prefix="ff_test_report_keys_"),
+                 "report_keys.json"))
 
 import jax  # noqa: E402
 
