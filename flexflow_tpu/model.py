@@ -31,6 +31,7 @@ from __future__ import annotations
 import itertools
 import os
 import time
+import warnings
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -843,6 +844,7 @@ class FFModel:
             best = None
             r = native_mcmc_search(self, budget=cfg.search_budget,
                                    alpha=cfg.search_alpha, machine_model=mm,
+                                   seed=cfg.seed,
                                    overlap=cfg.search_overlap_backward_update,
                                    verbose=False)
             if r is not None:
@@ -851,8 +853,21 @@ class FFModel:
                 from .simulator.search import mcmc_search
 
                 best = mcmc_search(self, budget=cfg.search_budget,
-                                   alpha=cfg.search_alpha, machine_model=mm)
+                                   alpha=cfg.search_alpha, machine_model=mm,
+                                   seed=cfg.seed)
             cfg.strategies.update(best)
+            # Both engines return a SearchResult carrying the simulated
+            # cost of the plan they just found — keep it for the
+            # provenance sidecar (and the pipeline comparison below)
+            # instead of re-simulating.
+            self._search_provenance = {
+                "engine": getattr(best, "engine", "mcmc"),
+                "budget": cfg.search_budget,
+                "seed": cfg.seed,
+                "best_s": getattr(best, "best_s", None),
+                "dp_s": getattr(best, "dp_s", None),
+                "machine_model": mm,
+            }
 
             # Stage-assignment search (--search-pipeline): when a GPipe
             # plan beats the best dim strategy AND the user hasn't placed
@@ -861,13 +876,16 @@ class FFModel:
             # space and placement are one mechanism, mapper.cc:33-146).
             if (cfg.search_pipeline
                     and getattr(self, "_pipeline_req", None) is None):
-                from .simulator.cost_model import CostModel
                 from .simulator.pipeline_search import search_pipeline
-                from .simulator.simulator import Simulator
 
-                sim = Simulator(mm, CostModel(
-                    mm, measure=False, compute_dtype=cfg.compute_dtype))
-                dims_t = sim.simulate_runtime(self, dict(best))
+                dims_t = getattr(best, "best_s", None)
+                if dims_t is None:
+                    from .simulator.cost_model import CostModel
+                    from .simulator.simulator import Simulator
+
+                    sim = Simulator(mm, CostModel(
+                        mm, measure=False, compute_dtype=cfg.compute_dtype))
+                    dims_t = sim.simulate_runtime(self, dict(best))
                 plan = search_pipeline(self, machine_model=mm)
                 if plan is not None and plan["simulated_s"] < dims_t:
                     print(f"flexflow_tpu: search selected a pipeline plan "
@@ -908,7 +926,9 @@ class FFModel:
         # Export AFTER resolution so imported/searched configs are what get
         # written (reference exports from FFConfig::strategies the same way).
         if cfg.export_strategy_file:
-            save_strategies_to_file(cfg.export_strategy_file, self._all_strategies())
+            save_strategies_to_file(cfg.export_strategy_file,
+                                    self._all_strategies(),
+                                    provenance=self._export_provenance())
 
         # Label tensor (reference creates it in compile; dims follow loss).
         logits = self._loss_input_tensor()
@@ -932,6 +952,34 @@ class FFModel:
     def _all_strategies(self) -> Dict[str, ParallelConfig]:
         return {op.name: getattr(op, "pc", ParallelConfig.data_parallel(
             op.output.num_dims, self.machine.num_devices)) for op in self.ops}
+
+    def _export_provenance(self) -> Optional[Dict[str, Any]]:
+        """Provenance sidecar payload for an exported strategy: which
+        search produced it (engine/budget/seed + simulated cost when
+        compile ran one; "import"/"manual" otherwise) and per-op cost
+        attribution.  Advisory — never lets a simulator failure break
+        the export itself."""
+        sp = getattr(self, "_search_provenance", None)
+        try:
+            from .observability.searchtrace import build_provenance
+
+            extra = {}
+            if self.config.import_strategy_file:
+                extra["imported_from"] = self.config.import_strategy_file
+            if sp is None:
+                engine = "import" if self.config.import_strategy_file \
+                    else "manual"
+                return build_provenance(self, self._all_strategies(),
+                                        engine=engine, budget=0,
+                                        seed=self.config.seed, extra=extra)
+            return build_provenance(
+                self, self._all_strategies(), engine=sp["engine"],
+                budget=sp["budget"], seed=sp["seed"], best_s=sp["best_s"],
+                dp_s=sp["dp_s"], machine_model=sp["machine_model"],
+                extra=extra)
+        except Exception as e:  # noqa: BLE001 — sidecar is best-effort
+            warnings.warn(f"strategy provenance sidecar not written: {e}")
+            return None
 
     def final_tensor(self) -> Tensor:
         return self.ops[-1].output

@@ -27,11 +27,20 @@ the hot path performs ZERO event-log calls — every site guards on a
 ``agreement`` — continuous simulator validation: predicted per-op /
                 per-step times diffed against measured walls as
                 ``sim_prediction`` / ``sim_divergence`` events.
+``searchtrace`` — the search flight recorder: per-proposal
+                ``search_candidate`` events from the MCMC engines,
+                per-op "why this config" summaries (incl. best
+                rejected alternative), and the provenance payload a
+                strategy-file ``.meta.json`` sidecar carries.  Folded
+                by ``tools/search_report.py`` (report + strategy
+                ``--diff``).
 """
 
-from . import events, health
+from . import events, health, searchtrace
 from .events import EventLog, active_log, for_config
 from .health import HealthMonitor, read_heartbeat, write_heartbeat
+from .searchtrace import SearchRecorder
 
-__all__ = ["EventLog", "HealthMonitor", "active_log", "events",
-           "for_config", "health", "read_heartbeat", "write_heartbeat"]
+__all__ = ["EventLog", "HealthMonitor", "SearchRecorder", "active_log",
+           "events", "for_config", "health", "read_heartbeat",
+           "searchtrace", "write_heartbeat"]

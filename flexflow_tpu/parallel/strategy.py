@@ -17,14 +17,32 @@ a file produced by the *reference*, pass ``reference_order=True`` (CLI:
 ``--import-reference-order``, FFConfig.import_strategy_reference_order) to
 reverse each op's dims on import — the wire format itself cannot indicate
 which convention a file uses.
+
+Provenance: the ``.pb`` wire format has no room for metadata, so a save
+may stamp an optional JSON sidecar ``<file>.meta.json`` recording which
+engine/budget/seed produced the strategy, its simulated cost, per-op
+cost attribution, and a content hash of the ``.pb`` itself (a sidecar
+whose hash no longer matches its strategy is reported ``stale``).
+Loading reads the sidecar back tolerantly — a missing, corrupt, or
+truncated sidecar never breaks a load — and, when telemetry is active,
+logs a ``strategy_provenance`` event so a training trace links back to
+the search trace that produced its strategy
+(``observability/searchtrace.py``, ``tools/search_report.py``).
 """
 
 from __future__ import annotations
 
+import hashlib
 import io
-from typing import Dict, List, Tuple
+import json
+import os
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..config import DeviceType, ParallelConfig
+
+PROVENANCE_VERSION = 1
 
 _WIRE_VARINT = 0
 _WIRE_LEN = 2
@@ -126,8 +144,11 @@ def _decode_op(data: bytes) -> Tuple[str, ParallelConfig]:
                                 tuple(memory_types))
 
 
-def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]) -> None:
-    """Serialize (reference: strategy.cc:128-163)."""
+def save_strategies_to_file(filename: str,
+                            strategies: Dict[str, ParallelConfig],
+                            provenance: Optional[Dict[str, Any]] = None) -> None:
+    """Serialize (reference: strategy.cc:128-163).  With ``provenance``,
+    also stamp the ``<filename>.meta.json`` sidecar."""
     buf = io.BytesIO()
     for name, pc in strategies.items():
         body = _encode_op(name, pc)
@@ -136,11 +157,18 @@ def save_strategies_to_file(filename: str, strategies: Dict[str, ParallelConfig]
         buf.write(body)
     with open(filename, "wb") as f:
         f.write(buf.getvalue())
+    if provenance is not None:
+        write_provenance(filename, provenance)
 
 
 def load_strategies_from_file(filename: str, reference_order: bool = False) -> Dict[str, ParallelConfig]:
     """Parse (reference: strategy.cc:87-126).  ``reference_order=True``
-    reverses each op's dims from Legion adim order into natural order."""
+    reverses each op's dims from Legion adim order into natural order.
+
+    When telemetry is active, emits a ``strategy_provenance`` event
+    linking this load to the sidecar's recorded search (or naming the
+    provenance missing/stale) — so a training trace always says where
+    its strategy came from."""
     with open(filename, "rb") as f:
         data = f.read()
     out: Dict[str, ParallelConfig] = {}
@@ -159,4 +187,81 @@ def load_strategies_from_file(filename: str, reference_order: bool = False) -> D
                 pc = ParallelConfig(pc.device_type, tuple(reversed(pc.dims)),
                                     pc.device_ids, pc.memory_types)
             out[name] = pc
+    _emit_provenance_event(filename, out, data)
     return out
+
+
+# ----------------------------------------------------------------------
+# provenance sidecar (<file>.meta.json)
+# ----------------------------------------------------------------------
+
+def sidecar_path(filename: str) -> str:
+    return filename + ".meta.json"
+
+
+def strategy_content_hash(data: bytes) -> str:
+    """Content hash binding a sidecar to its ``.pb`` bytes."""
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def write_provenance(filename: str, meta: Dict[str, Any]) -> str:
+    """Stamp ``<filename>.meta.json``: the caller's metadata (engine,
+    budget, seed, costs, per-op attribution — see
+    ``observability.searchtrace.build_provenance``) plus the schema
+    version, creation time, and the ``.pb`` content hash.  Returns the
+    sidecar path."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    out = dict(meta)
+    out["provenance_version"] = PROVENANCE_VERSION
+    out["strategy_file"] = os.path.basename(filename)
+    out["content_hash"] = strategy_content_hash(data)
+    out["created_unix"] = time.time()
+    path = sidecar_path(filename)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def read_provenance(filename: str) -> Optional[Dict[str, Any]]:
+    """The sidecar's metadata, or None when absent or unreadable.  A
+    corrupt/truncated sidecar warns and is otherwise ignored — sidecars
+    are advisory and must never break a strategy load."""
+    path = sidecar_path(filename)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+        if not isinstance(meta, dict):
+            raise ValueError(f"expected a JSON object, got {type(meta).__name__}")
+        return meta
+    except Exception as e:  # noqa: BLE001 — advisory metadata only
+        warnings.warn(f"ignoring corrupt strategy sidecar {path}: {e}",
+                      stacklevel=2)
+        return None
+
+
+def _emit_provenance_event(filename: str, strategies: Dict[str, ParallelConfig],
+                           data: bytes) -> None:
+    # events.py is stdlib-only and active_log() is one dict lookup when
+    # telemetry is off — loading stays cheap on untraced runs.
+    from ..observability.events import active_log
+
+    log = active_log()
+    if log is None:
+        return
+    attrs: Dict[str, Any] = {"file": filename, "num_ops": len(strategies)}
+    meta = read_provenance(filename)
+    if meta is None:
+        attrs["provenance"] = "missing"
+    else:
+        recorded = meta.get("content_hash")
+        attrs["provenance"] = (
+            "ok" if recorded == strategy_content_hash(data) else "stale")
+        for key in ("engine", "budget", "seed", "num_devices", "best_ms",
+                    "search_run_id"):
+            if key in meta:
+                attrs[key] = meta[key]
+    log.event("strategy_provenance", **attrs)

@@ -280,7 +280,11 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
     import time as _time
 
     from ..observability.events import active_log
+    from ..observability.searchtrace import SearchRecorder
     tel = active_log()
+    rec = SearchRecorder.maybe("native", budget, nd, seed, log=tel)
+    if rec is not None:
+        rec.start(candidates=int(cand_off[-1]))
     anneal_t0 = _time.perf_counter()
     best_rt = lib.ffsearch_anneal(
         mm.num_devices, mm.chips_per_host, mm.torus[0], mm.torus[1],
@@ -302,8 +306,13 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
         _ptr(a_choice_init, ctypes.c_int32),
         _ptr(a_choice_out, ctypes.c_int32), ctypes.byref(dp_rt))
 
-    best = {op.name: cand_lists[i][int(a_choice_out[i])]
-            for i, op in enumerate(ops)}
+    from .search import SearchResult
+
+    best = SearchResult({op.name: cand_lists[i][int(a_choice_out[i])]
+                         for i, op in enumerate(ops)},
+                        engine="native", budget=budget, seed=seed,
+                        num_devices=nd, best_s=float(best_rt),
+                        dp_s=float(dp_rt.value))
     if tel is not None:
         # the C engine owns the loop, so the span covers the whole anneal
         # and the end event carries its summary numbers
@@ -312,6 +321,11 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
                     budget=budget, candidates=int(cand_off[-1]),
                     dp_ms=round(dp_rt.value * 1e3, 3),
                     best_ms=round(float(best_rt) * 1e3, 3))
+        if rec is not None:
+            # per-op final configs (no candidate stream — the loop runs
+            # in C), so search_report's "why" table still covers every op
+            rec.finish(best, best_ms=float(best_rt) * 1e3,
+                       initial_ms=float(dp_rt.value) * 1e3)
         tel.flush()
     if verbose:
         print(f"native search: dp {dp_rt.value * 1e3:.3f} ms/iter -> "

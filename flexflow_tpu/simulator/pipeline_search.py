@@ -241,6 +241,11 @@ def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
     large-M, small-bubble corner of the grid memory-feasible); passing
     ``microbatches`` restricts the sweep to {M, 2M} for callers that
     want the legacy behavior."""
+    import contextlib
+
+    from ..observability.events import active_log
+    from ..observability.searchtrace import SearchRecorder
+
     nd = model.machine.num_devices if model.machine is not None \
         else model.config.num_devices
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
@@ -248,27 +253,48 @@ def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
     cost = CostModel(mm, measure=False, compute_dtype=dtype)
     batch = model.ops[0].output.dims[0] if model.ops else 0
     best = None
-    for S in [d for d in range(2, nd + 1) if nd % d == 0]:
-        dp = nd // S
-        if batch <= 0 or batch % dp != 0:
-            continue
-        local_b = batch // dp
-        if microbatches is None:
-            Ms = [m for m in range(1, local_b + 1) if local_b % m == 0]
-        else:
-            Ms = sorted({microbatches, 2 * microbatches})
-        prep = _stage_prep(model, S)
-        if prep is None:
-            continue
-        for M in Ms:
-            r = cost_pipeline_plan(model, mm, cost, S, dp, M, prep=prep)
-            if r is not None and (best is None
-                                  or r["t"] < best["simulated_s"]):
-                # report the ADJUSTED microbatch count the costing
-                # used — the requested one may not divide the batch
-                best = {"num_stages": S, "dp_degree": dp,
-                        "num_microbatches": r["m"], "remat": r["remat"],
-                        "simulated_s": r["t"], "mem_bytes": r["mem"]}
+    tel = active_log()
+    rec = SearchRecorder.maybe("pipeline", 0, nd, log=tel)
+    span = tel.span("pipeline_search", num_devices=nd) \
+        if tel is not None else contextlib.nullcontext({})
+    with span as span_attrs:
+        plans = 0
+        for S in [d for d in range(2, nd + 1) if nd % d == 0]:
+            dp = nd // S
+            if batch <= 0 or batch % dp != 0:
+                continue
+            local_b = batch // dp
+            if microbatches is None:
+                Ms = [m for m in range(1, local_b + 1) if local_b % m == 0]
+            else:
+                Ms = sorted({microbatches, 2 * microbatches})
+            prep = _stage_prep(model, S)
+            if prep is None:
+                continue
+            for M in Ms:
+                r = cost_pipeline_plan(model, mm, cost, S, dp, M, prep=prep)
+                if r is None:
+                    continue
+                plans += 1
+                improved = best is None or r["t"] < best["simulated_s"]
+                if rec is not None:
+                    rec.plan(f"S{S}xdp{dp},M{r['m']}"
+                             f"{',remat' if r['remat'] else ''}",
+                             cost_ms=r["t"] * 1e3, accepted=improved,
+                             stages=S, dp=dp, m=r["m"], remat=r["remat"])
+                if improved:
+                    # report the ADJUSTED microbatch count the costing
+                    # used — the requested one may not divide the batch
+                    best = {"num_stages": S, "dp_degree": dp,
+                            "num_microbatches": r["m"], "remat": r["remat"],
+                            "simulated_s": r["t"], "mem_bytes": r["mem"]}
+            if tel is not None:
+                tel.event("search_progress", engine="pipeline", iter=S,
+                          best_ms=round(best["simulated_s"] * 1e3, 3)
+                          if best else 0.0)
+        span_attrs["plans"] = plans
+        if best is not None:
+            span_attrs["best_ms"] = round(best["simulated_s"] * 1e3, 3)
     return best
 
 
@@ -302,7 +328,11 @@ def suggest_parallelization(model, budget: int = 2000,
     if best_dims is None:
         best_dims = mcmc_search(model, budget=budget, machine_model=mm,
                                 seed=seed, verbose=False)
-    dims_t = sim.simulate_runtime(model, best_dims)
+    # both engines report the simulated cost of the plan they return —
+    # re-simulate only for a caller-supplied plain dict
+    dims_t = getattr(best_dims, "best_s", None)
+    if dims_t is None:
+        dims_t = sim.simulate_runtime(model, best_dims)
 
     pipe = search_pipeline(model, machine_model=mm,
                            microbatches=microbatches)

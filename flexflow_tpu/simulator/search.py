@@ -105,12 +105,35 @@ def random_parallel_config(op, num_devices: int, rng: random.Random,
     return pc.with_device_ids(tuple(range(start, start + n)))
 
 
+class SearchResult(Dict[str, ParallelConfig]):
+    """The best strategy map found, plus the search's own account of
+    itself: simulated cost of the best plan (``best_s``) and of the
+    data-parallel start (``dp_s``), engine/budget/seed/devices.  A dict
+    subclass so every pre-existing caller that treats the result as a
+    plain {op: ParallelConfig} map keeps working, while ``compile()``
+    and the provenance sidecar no longer need to RE-simulate the plan
+    the search just finished costing."""
+
+    def __init__(self, strategies: Dict[str, ParallelConfig],
+                 engine: str = "", budget: int = 0, seed: int = 0,
+                 num_devices: int = 0, best_s: Optional[float] = None,
+                 dp_s: Optional[float] = None):
+        super().__init__(strategies)
+        self.engine = engine
+        self.budget = budget
+        self.seed = seed
+        self.num_devices = num_devices
+        self.best_s = best_s
+        self.dp_s = dp_s
+
+
 def mcmc_search(model, budget: int, alpha: float = 0.05,
                 machine_model: Optional[TPUMachineModel] = None,
                 measure: bool = False, seed: int = 0,
                 overlap_backward_update: Optional[bool] = None,
-                verbose: bool = True) -> Dict[str, ParallelConfig]:
-    """Returns the best strategy map found (op name → ParallelConfig)."""
+                verbose: bool = True) -> "SearchResult":
+    """Returns the best strategy map found (op name → ParallelConfig),
+    as a ``SearchResult`` carrying the simulated best cost."""
     nd = model.machine.num_devices if model.machine is not None \
         else model.config.num_devices
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
@@ -133,16 +156,22 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
                for op in model.ops}
     current_rt = sim.simulate_runtime(model, current)
     best, best_rt = dict(current), current_rt
+    dp_rt = current_rt
 
     import contextlib
 
     from ..observability.events import active_log
+    from ..observability.searchtrace import SearchRecorder
     tel = active_log()
+    rec = SearchRecorder.maybe("mcmc", budget, nd, seed, log=tel)
+    if rec is not None:
+        rec.start(initial_ms=dp_rt * 1e3)
     span = tel.span("mcmc_search", budget=budget, num_devices=nd) \
         if tel is not None else contextlib.nullcontext({})
     with span as span_attrs:
         for it in range(budget):
             op = rng.choice(model.ops)
+            old_pc = current[op.name]
             nxt = dict(current)
             # Legalize through the op hook so configs whose dims carry
             # non-size meaning (PipelineMLP pipe degree) are clamped
@@ -161,10 +190,27 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
                               best_ms=round(best_rt * 1e3, 3))
             if nxt_rt < best_rt:
                 best_rt, best = nxt_rt, dict(nxt)
-            if nxt_rt < current_rt or rng.random() < math.exp(
-                    -alpha * (nxt_rt - current_rt) * 1e3):
+            # Accept semantics unchanged from the reference (downhill
+            # always; uphill with Metropolis probability) — spelled out
+            # so the recorder can carry the reason + probability.  The
+            # rng draw happens ONLY on uphill moves, exactly as the
+            # short-circuited original did: seeded runs reproduce the
+            # same strategies with or without telemetry.
+            if nxt_rt < current_rt:
+                accepted, reason, prob = True, "downhill", None
+            else:
+                prob = math.exp(-alpha * (nxt_rt - current_rt) * 1e3)
+                accepted, reason = rng.random() < prob, "metropolis"
+            if rec is not None:
+                rec.candidate(it, op.name, old_pc, nxt[op.name],
+                              cur_ms=current_rt * 1e3, new_ms=nxt_rt * 1e3,
+                              best_ms=best_rt * 1e3, accepted=accepted,
+                              reason=reason, prob=prob)
+            if accepted:
                 current, current_rt = nxt, nxt_rt
         span_attrs["best_ms"] = round(best_rt * 1e3, 3)
+    if rec is not None:
+        rec.finish(best, best_ms=best_rt * 1e3)
     if tel is not None:
         tel.flush()
     if verbose:
@@ -172,4 +218,5 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
         for name, pc in best.items():
             print(f"[{name}] dims{list(pc.dims)} parts({pc.num_parts()})")
         print(f"simulated runtime: {best_rt * 1e3:.3f} ms/iter")
-    return best
+    return SearchResult(best, engine="mcmc", budget=budget, seed=seed,
+                        num_devices=nd, best_s=best_rt, dp_s=dp_rt)

@@ -83,6 +83,31 @@ def _optional_deps():
     return ", ".join(mods) or "none"
 
 
+def _observability():
+    # Effective config as events.py/health.py will see it, plus a
+    # write probe of the configured trace sink — a read-only sink
+    # otherwise fails silently at flush time, long after launch.
+    from ..observability import events
+
+    tel = os.environ.get("FF_TELEMETRY", "")
+    sink = events.default_path()
+    health = os.environ.get("FF_HEALTH", "")
+    hb = os.environ.get("FF_HEARTBEAT_PATH", "")
+    bits = [f"FF_TELEMETRY={'on' if events._env_enabled() else tel or 'off'}",
+            f"sink={sink}",
+            f"FF_HEALTH={health or 'off'}",
+            f"FF_HEARTBEAT_PATH={hb or 'off'}"]
+    d = os.path.dirname(os.path.abspath(sink)) or "."
+    if not os.path.isdir(d):
+        bits.append(f"sink dir missing: {d}")
+    elif not os.access(d, os.W_OK):
+        raise PermissionError(f"trace sink dir not writable: {d} "
+                              f"({', '.join(bits)})")
+    else:
+        bits.append("sink writable")
+    return ", ".join(bits)
+
+
 def _cpu_train():
     import jax
 
@@ -127,6 +152,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         plan.append(("accelerator", _accelerator, False))
     plan += [("native libs", _native_libs, False),
              ("optional deps", _optional_deps, False),
+             ("observability", _observability, False),
              ("cpu training", _cpu_train, True)]
 
     # print each line as its check completes — the slow checks (90s

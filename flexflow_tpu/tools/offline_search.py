@@ -127,7 +127,11 @@ def main(argv: Optional[List[str]] = None):
         best = mcmc_search(model, budget=args.budget, alpha=args.alpha,
                            machine_model=mm, measure=False, seed=args.seed,
                            verbose=not args.quiet)
-    best_rt = sim.simulate_runtime(model, best)
+    # Both engines return a SearchResult that already carries its
+    # simulated best cost — re-simulate only for a plain-dict result.
+    best_rt = getattr(best, "best_s", None)
+    if best_rt is None:
+        best_rt = sim.simulate_runtime(model, best)
     speedup = dp_rt / best_rt if best_rt > 0 else float("inf")
     print(f"data-parallel: {dp_rt * 1e3:.3f} ms/iter; "
           f"searched: {best_rt * 1e3:.3f} ms/iter; "
@@ -152,8 +156,18 @@ def main(argv: Optional[List[str]] = None):
                   f"remat={rm}))")
 
     if args.export:
-        save_strategies_to_file(args.export, best)
-        print(f"exported strategy -> {args.export}")
+        from ..observability.searchtrace import build_provenance
+        from ..parallel.strategy import sidecar_path
+
+        prov = build_provenance(
+            model, dict(best),
+            engine=getattr(best, "engine", args.engine),
+            budget=args.budget, seed=args.seed,
+            best_s=best_rt, dp_s=dp_rt, machine_model=mm,
+            extra={"model": args.model, "tool": "offline_search"})
+        save_strategies_to_file(args.export, best, provenance=prov)
+        print(f"exported strategy -> {args.export} "
+              f"(+ {sidecar_path(args.export)})")
     return best
 
 

@@ -122,7 +122,7 @@ def render_report(records: List[Dict[str, Any]], top_k: int = 8) -> str:
     # ---- phase breakdown ----------------------------------------------
     phase_names = ["compile", "data_wait", "metric_drain",
                    "checkpoint_save", "checkpoint_restore", "fit_epoch",
-                   "mcmc_search", "native_search"]
+                   "mcmc_search", "native_search", "pipeline_search"]
     phase_rows = []
     for name in phase_names:
         ss = spans.get(name)

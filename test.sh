@@ -38,6 +38,27 @@ grep -q '"phase"' "$HEARTBEAT" \
   || { echo "health smoke: heartbeat file missing/empty"; exit 1; }
 echo "telemetry+health smoke: OK ($(wc -l < "$TRACE") trace records)"
 
+# Search-observability smoke: a seeded tiny-budget search must produce a
+# candidate-level trace + provenance sidecar, search_report must explain
+# it, and --diff must name changed ops vs the shipped strategy
+# (docs/observability.md "Search tracing").  --engine python so every
+# proposal is recorded (the native engine logs summaries only).
+STRACE="$SMOKE_DIR/search.jsonl"
+FF_TELEMETRY=1 FF_TELEMETRY_FILE="$STRACE" \
+  python -m flexflow_tpu.tools.offline_search alexnet --devices 16 \
+    --budget 20 --seed 0 --engine python --quiet \
+    --export "$SMOKE_DIR/alexnet_new.pb" > /dev/null
+test -f "$SMOKE_DIR/alexnet_new.pb.meta.json" \
+  || { echo "search smoke: provenance sidecar missing"; exit 1; }
+SREPORT=$(python -m flexflow_tpu.tools.search_report "$STRACE")
+echo "$SREPORT" | grep -q "## Why this config" \
+  || { echo "search smoke: report missing why-this-config section"; exit 1; }
+python -m flexflow_tpu.tools.search_report \
+    --diff strategies/alexnet_16.pb "$SMOKE_DIR/alexnet_new.pb" \
+  | grep -q "changed /" \
+  || { echo "search smoke: strategy diff failed"; exit 1; }
+echo "search smoke: OK ($(wc -l < "$STRACE") trace records)"
+
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \
             examples/keras/seq_mnist_mlp.py \
