@@ -132,6 +132,12 @@ class FFModel:
         # Health monitor (observability/health.py): non-None only when
         # FF_HEALTH rides an enabled telemetry log.
         self._health = None
+        # Fault injector (testing/chaos.py, FF_CHAOS) and non-finite
+        # step guard (runtime/resilience.py, FF_SKIP_NONFINITE) — both
+        # resolved once at compile(), None when their env knob is unset
+        # so every choke point is a single attribute test.
+        self._chaos = None
+        self._nonfinite_guard = None
         # Simulator's predicted step seconds (observability/agreement.py,
         # set post-compile under telemetry) for sim_divergence events.
         self._predicted_step_s = None
@@ -780,12 +786,21 @@ class FFModel:
         """
         from .observability import events as _ff_events
         from .observability import health as _ff_health
+        from .runtime import resilience as _ff_resilience
+        from .testing import chaos as _ff_chaos
 
         # Heartbeat is independent of telemetry (stdlib; no-op unless
         # FF_HEARTBEAT_PATH is set): an external watchdog can name a
         # wedged compile even on an untraced run.
         _ff_health.write_heartbeat("compile")
         self._telemetry = _ff_events.for_config(self.config)
+        # Chaos + the non-finite guard are independent of telemetry
+        # (recovery must work on untraced runs; events are narration).
+        self._chaos = _ff_chaos.from_env()
+        _nf = _ff_resilience.nonfinite_limit()
+        self._nonfinite_guard = (
+            _ff_resilience.NonFiniteGuard(self, _nf, self._telemetry)
+            if _nf else None)
         if self._telemetry is None:
             self._stepstats = None
             self._health = None
@@ -1736,7 +1751,9 @@ class FFModel:
 
         accum = max(1, int(self.config.grad_accum_steps))
 
-        track_health = self._health is not None
+        # The guard needs the isfinite entries even without FF_HEALTH.
+        guard_on = self._nonfinite_guard is not None
+        track_health = self._health is not None or guard_on
 
         def health_metrics(loss, grads):
             # Device-side isfinite reduction over the loss and the
@@ -1764,6 +1781,36 @@ class FFModel:
             # without a host round-trip per step.
             return jnp.stack([jnp.float32(msum.get(k, 0.0)) for k in mkeys])
 
+        def guard_finalize(params, stats, opt_state, new_params, new_stats,
+                           new_opt, mvec, macc):
+            # Non-finite step guard (runtime/resilience.py): when this
+            # step's loss or grad-norm was non-finite, select the
+            # PRE-step params/stats/opt-state back — a functional
+            # in-jit select, so it is donation-safe (no host reference
+            # to the donated input buffers) and the restore is bitwise.
+            # The skipped step contributes only its health entries plus
+            # skipped_steps=1 to the metric vector (steps stays 0), so
+            # window means cover applied steps only; consec_skipped is
+            # a run length, reset by any good step.
+            from .observability.health import HEALTH_METRIC_KEYS
+            bad = (mvec[mkeys.index("nonfinite_loss")]
+                   + mvec[mkeys.index("nonfinite_grad")]) > 0
+
+            def sel(old, new):
+                return jax.tree.map(lambda o, n: jnp.where(bad, o, n),
+                                    old, new)
+
+            hmask = jnp.zeros((len(mkeys),), jnp.float32)
+            for k in HEALTH_METRIC_KEYS:
+                hmask = hmask.at[mkeys.index(k)].set(1.0)
+            skip_vec = (mvec * hmask).at[
+                mkeys.index("skipped_steps")].set(1.0)
+            out = macc + jnp.where(bad, skip_vec, mvec)
+            ci = mkeys.index("consec_skipped")
+            out = out.at[ci].set(jnp.where(bad, macc[ci] + 1.0, 0.0))
+            return (sel(params, new_params), sel(stats, new_stats),
+                    sel(opt_state, new_opt), out)
+
         def step(params, stats, opt_state, hparams, batch, step_idx, macc):
             rng = jax.random.fold_in(base_key, step_idx)
             labels = batch["label"]
@@ -1779,6 +1826,9 @@ class FFModel:
             if track_health:
                 mvec = mvec + health_metrics(loss, grads)
             new_params, new_opt = opt.apply(params, grads, opt_state, hparams)
+            if guard_on:
+                return guard_finalize(params, stats, opt_state, new_params,
+                                      new_stats, new_opt, mvec, macc)
             return new_params, new_stats, new_opt, macc + mvec
 
         def step_accum(params, stats, opt_state, hparams, batch, step_idx,
@@ -1826,6 +1876,9 @@ class FFModel:
                 mvec = mvec + health_metrics(
                     mvec[mkeys.index("loss")], grads)
             new_params, new_opt = opt.apply(params, grads, opt_state, hparams)
+            if guard_on:
+                return guard_finalize(params, stats, opt_state, new_params,
+                                      new_stats, new_opt, mvec, macc)
             return new_params, new_stats, new_opt, macc + mvec
 
         return jax.jit(step if accum == 1 else step_accum,
@@ -1896,15 +1949,24 @@ class FFModel:
     def _metric_keys(self) -> List[str]:
         keys = ["train_all", "train_correct", "cce_loss", "sparse_cce_loss",
                 "mse_loss", "rmse_loss", "mae_loss", "loss", "steps"]
-        if self._health is not None:
+        if self._health is not None or self._nonfinite_guard is not None:
             # Health entries ride the same on-device vector (non-finite
             # loss/grad counts + summed grad norm) so detection costs
             # zero extra dispatches; the drain pops them before
-            # PerfMetrics sees the dict.
-            keys += list(self._health.METRIC_KEYS)
+            # PerfMetrics sees the dict.  The guard needs them even
+            # when FF_HEALTH is off — its skip decision keys off them.
+            from .observability.health import HEALTH_METRIC_KEYS
+            keys += list(HEALTH_METRIC_KEYS)
+        if self._nonfinite_guard is not None:
+            keys += list(self._nonfinite_guard.METRIC_KEYS)
         return keys
 
     def update(self) -> None:
+        # The step choke point fires on the GLOBAL step index, so an
+        # exact trigger is resume-aware: after a restore past it, the
+        # fault never re-fires.
+        if self._chaos is not None:
+            self._chaos.fire("step", index=self._step_count, model=self)
         # _stepstats is non-None only under telemetry; the disabled path
         # is a single attribute test.
         if self._stepstats is not None:
@@ -1919,6 +1981,12 @@ class FFModel:
             self._opt_state = self._init_opt_state()
         if self._metric_acc is None:
             self._metric_acc = jnp.zeros((len(self._metric_keys()),), jnp.float32)
+            guard = self._nonfinite_guard
+            if guard is not None and guard.consec:
+                # re-seed the run length a reset_metrics discarded
+                ci = self._metric_keys().index("consec_skipped")
+                self._metric_acc = self._metric_acc.at[ci].set(
+                    float(guard.consec))
         hp = self.optimizer.hparams()
         # Host-offloaded weights stream on-chip for the step and back
         # after (eager device_put at the jit boundary: the reference's
@@ -2383,6 +2451,11 @@ class FFModel:
     # metrics (reference: UPDATE_METRICS_TASK fold, model.cc:1145-1167)
     # ------------------------------------------------------------------
     def reset_metrics(self) -> None:
+        if self._nonfinite_guard is not None and self._metric_acc is not None:
+            # Guard entries (skip counts, consec run length) ride the
+            # accumulator — drain before discarding so narration and
+            # escalation can't be dropped by an epoch-boundary reset.
+            self._drain_metrics()
         self.current_metrics.reset()
         self.last_loss = None
         self._metric_acc = None
@@ -2399,12 +2472,35 @@ class FFModel:
             loss_sum = totals.pop("loss", None)
             if steps > 0 and loss_sum is not None:
                 self.last_loss = loss_sum / steps  # mean loss since last drain
+            guard = self._nonfinite_guard
+            guard_vals = None
+            if guard is not None:
+                guard_vals = {k: totals.pop(k, 0.0) for k in guard.METRIC_KEYS}
             if self._health is not None:
+                from .observability.health import HEALTH_METRIC_KEYS
                 health_vals = {k: totals.pop(k) for k in
-                               self._health.METRIC_KEYS if k in totals}
+                               HEALTH_METRIC_KEYS if k in totals}
                 self._health.on_drain(health_vals, steps, self._step_count)
+            elif guard is not None:
+                # Health entries rode the vector only for the guard's
+                # skip decision; pop so they don't leak into PerfMetrics.
+                from .observability.health import HEALTH_METRIC_KEYS
+                for k in HEALTH_METRIC_KEYS:
+                    totals.pop(k, None)
             self.current_metrics.update(totals)
             self._metric_acc = jnp.zeros_like(self._metric_acc)
+            if guard_vals is not None:
+                consec = guard_vals.get("consec_skipped", 0.0)
+                if consec > 0:
+                    # consec_skipped is a run length, not a window sum:
+                    # carry it through the accumulator reset so a NaN
+                    # streak spanning drains still escalates.
+                    ci = self._metric_keys().index("consec_skipped")
+                    self._metric_acc = self._metric_acc.at[ci].set(consec)
+                # Last: on_drain may raise NonFiniteEscalationError and
+                # the window's totals are already folded in above.
+                guard.on_drain(guard_vals.get("skipped_steps", 0.0),
+                               consec, steps, self._step_count)
 
     def get_metrics(self) -> PerfMetrics:
         self._drain_metrics()
@@ -2419,6 +2515,8 @@ class FFModel:
         small device→host transfer: a real synchronization barrier on
         every backend (block_until_ready alone does not block on some
         experimental PJRT platforms)."""
+        if self._chaos is not None:
+            self._chaos.fire("sync", model=self)
         self._he_join()
         if self._metric_acc is not None:
             jax.device_get(self._metric_acc)

@@ -178,19 +178,30 @@ def save_checkpoint(model, path: str, force: bool = True) -> None:
 
 
 def _save_checkpoint_impl(model, path: str, force: bool = True) -> None:
+    from .resilience import with_ckpt_retries
+
     # read barrier: an async host-table scatter-back may be in flight
     getattr(model, "_he_join", lambda: None)()
     if path.endswith(".npz"):
-        _save_npz(model, path)
+        with_ckpt_retries(lambda: _save_npz(model, path),
+                          model=model, site="ckpt_save", path=path)
         return
     try:
         import orbax.checkpoint as ocp
     except ImportError:
-        _save_npz(model, path + ".npz")
+        with_ckpt_retries(lambda: _save_npz(model, path + ".npz"),
+                          model=model, site="ckpt_save", path=path + ".npz")
         return
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, _tree_from_model(model), force=force)
+
+    def _do():
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, _tree_from_model(model), force=force)
+
+    # Retried on OSError (resilience.py): orbax writes into a temp dir
+    # and finalizes atomically, so a failed attempt leaves no partial
+    # checkpoint for the retry to trip over.
+    with_ckpt_retries(_do, model=model, site="ckpt_save", path=path)
 
 
 def load_checkpoint(model, path: str) -> None:
@@ -208,10 +219,13 @@ def load_checkpoint(model, path: str) -> None:
 
 
 def _load_checkpoint_impl(model, path: str) -> None:
+    from .resilience import with_ckpt_retries
+
     # an in-flight scatter-back would race the restored tables
     getattr(model, "_he_join", lambda: None)()
     if os.path.isfile(path) or path.endswith(".npz"):
-        _load_npz(model, path)
+        with_ckpt_retries(lambda: _load_npz(model, path),
+                          model=model, site="ckpt_restore", path=path)
         return
     import orbax.checkpoint as ocp
 
@@ -220,8 +234,13 @@ def _load_checkpoint_impl(model, path: str) -> None:
     targets = jax.tree.map(
         lambda x: ocp.utils.to_shape_dtype_struct(x) if hasattr(x, "shape") else x,
         template)
-    with ocp.StandardCheckpointer() as ckptr:
-        state = ckptr.restore(path, targets)
+
+    def _do():
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path, targets)
+
+    state = with_ckpt_retries(_do, model=model, site="ckpt_restore",
+                              path=path)
     _apply_tree(model, state)
 
 
@@ -240,7 +259,21 @@ def _flatten(tree, prefix=""):
 
 def _save_npz(model, path: str) -> None:
     flat = _flatten(_tree_from_model(model))
-    np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
+    final = path if path.endswith(".npz") else path + ".npz"
+    # Atomic: a crash mid-write must never corrupt the ONLY checkpoint.
+    # Sibling temp (same filesystem, so os.replace is a rename) keyed by
+    # pid so concurrent writers can't collide on the temp name.
+    tmp = f"{final}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def _convert_legacy_pipe(model, data) -> Dict[str, np.ndarray]:
@@ -367,23 +400,37 @@ class CheckpointManager:
                  save_interval_steps: int = 1):
         import orbax.checkpoint as ocp
 
+        self.directory = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps))
 
-    def save(self, model, step: Optional[int] = None) -> bool:
+    def save(self, model, step: Optional[int] = None,
+             force: bool = False) -> bool:
         import orbax.checkpoint as ocp
 
+        from .resilience import with_ckpt_retries
+
         step = model._step_count if step is None else step
-        if not self._mgr.should_save(step):
+        if not force and not self._mgr.should_save(step):
             return False  # skip the tree build (and any pipe unpack)
-        return self._mgr.save(step, args=ocp.args.StandardSave(
-            _tree_from_model(model)))
+        # force bypasses the interval policy — preemption/failure saves
+        # must land regardless of save_interval_steps.
+        return with_ckpt_retries(
+            lambda: self._mgr.save(
+                step, args=ocp.args.StandardSave(_tree_from_model(model)),
+                force=force),
+            model=model, site="ckpt_save", path=self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
 
     def restore_latest(self, model) -> Optional[int]:
         import orbax.checkpoint as ocp
+
+        from .resilience import with_ckpt_retries
 
         step = self._mgr.latest_step()
         if step is None:
@@ -392,7 +439,10 @@ class CheckpointManager:
         targets = jax.tree.map(
             lambda x: ocp.utils.to_shape_dtype_struct(x) if hasattr(x, "shape") else x,
             template)
-        state = self._mgr.restore(step, args=ocp.args.StandardRestore(targets))
+        state = with_ckpt_retries(
+            lambda: self._mgr.restore(
+                step, args=ocp.args.StandardRestore(targets)),
+            model=model, site="ckpt_restore", path=self.directory)
         _apply_tree(model, state)
         return step
 

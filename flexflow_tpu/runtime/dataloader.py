@@ -93,6 +93,17 @@ class DataLoader:
     def num_batches(self) -> int:
         return self.num_samples // self.batch_size
 
+    def skip_batches(self, n: int) -> None:
+        """Advance the epoch's cursor by ``n`` batches WITHOUT gathering
+        or staging them — the shuffle-stream fast-forward a step-granular
+        resume needs: after replaying completed epochs via ``reset()``,
+        skipping the already-consumed batches lands the next
+        ``next_batch`` on exactly the sample window the interrupted run
+        would have seen (runtime/elastic.py)."""
+        for _ in range(max(0, int(n))):
+            self.next_index = self._start_of(self.next_index) + self.batch_size
+        self._pending = None   # prefetched batch (if any) is now stale
+
     def _start_of(self, index: int) -> int:
         return 0 if index + self.batch_size > self.num_samples else index
 
@@ -105,6 +116,9 @@ class DataLoader:
 
     def next_batch(self, ff=None) -> None:
         ff = ff or self.ff
+        chaos = getattr(ff, "_chaos", None)
+        if chaos is not None:
+            chaos.fire("data", model=ff)
         # Heartbeat BEFORE the gather (no-op unless FF_HEARTBEAT_PATH is
         # set): a wedged input pipeline gets named by the watchdog.
         from ..observability.health import write_heartbeat

@@ -1,0 +1,272 @@
+"""Step-level recovery: skip-step guard, preemption save, retrying I/O.
+
+The reference FlexFlow is fail-stop (SURVEY §5.3/§5.4): a transient NaN
+step, a SIGTERM from the scheduler, or one failed checkpoint write each
+kill the whole job.  PRs 1-3 built the *detection* side (telemetry,
+FF_HEALTH non-finite sampling, heartbeats); this module is the
+*reaction* side, exercised end to end by the ``FF_CHAOS`` injector
+(testing/chaos.py):
+
+  * **NonFiniteGuard** (``FF_SKIP_NONFINITE=N``) — the jitted train
+    step already folds isfinite(loss)/isfinite(grad-norm) into the
+    on-device metric vector (observability/health.py); with the guard
+    on, the step ALSO selects the pre-step params / optimizer slots /
+    batchnorm stats when the step was non-finite — a functional,
+    donation-safe, bitwise restore with zero extra host syncs.  The
+    skipped step rides the metric vector (``skipped_steps`` count +
+    ``consec_skipped`` run length); at each metric drain the guard
+    emits a ``step_skipped`` event and raises
+    ``NonFiniteEscalationError`` once N consecutive steps skipped —
+    a persistent divergence is not something to skip past,
+
+  * **PreemptionHandler** — SIGTERM/SIGINT set a cooperative flag; the
+    elastic loop drains in-flight device work at the next step
+    boundary, saves a checkpoint, writes a resume marker, emits
+    ``preemption_save``, and exits cleanly via ``Preempted`` (a
+    ``SystemExit(0)`` subclass: an unhandled preemption is still a
+    clean exit for the scheduler),
+
+  * **retrying atomic checkpoint I/O** (``FF_CKPT_RETRIES``,
+    ``FF_CKPT_BACKOFF_S``) — ``with_ckpt_retries`` wraps every
+    checkpoint read/write with the chaos choke point, bounded
+    exponential backoff on OSError, and a ``ckpt_retry`` event per
+    retried attempt.  The npz writer is atomic (sibling temp file +
+    ``os.replace``) so no failure mode leaves a partial checkpoint.
+
+All knobs read the environment once per call site (plain dict lookups);
+nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+MAX_BACKOFF_S = 30.0
+
+RESUME_META_FILE = "resume_meta.json"
+
+# Metric-vector entries the train step appends when the guard is on;
+# the drain pops them before PerfMetrics sees the dict (model.py).
+GUARD_METRIC_KEYS = ("skipped_steps", "consec_skipped")
+
+
+class NonFiniteEscalationError(RuntimeError):
+    """Too many consecutive non-finite steps — skipping stopped helping."""
+
+
+class ResumeMismatchError(RuntimeError):
+    """The dataset geometry changed between the checkpointed run and the
+    resume (steps-per-epoch differs), so the epoch/step resume math
+    would silently land in the wrong place."""
+
+
+class Preempted(SystemExit):
+    """Raised by the elastic loop after a preemption save.  Subclasses
+    SystemExit with code 0: unhandled, the process exits cleanly —
+    exactly what a preempting scheduler wants to see."""
+
+    def __init__(self, step: int):
+        super().__init__(0)
+        self.step = int(step)
+
+    def __str__(self) -> str:
+        return f"preempted: checkpoint saved at step {self.step}"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def nonfinite_limit() -> int:
+    """``FF_SKIP_NONFINITE``: 0/unset = guard off; N>0 = skip non-finite
+    steps, escalating after N consecutive skips."""
+    return max(0, _env_int("FF_SKIP_NONFINITE", 0))
+
+
+def ckpt_retries() -> int:
+    """``FF_CKPT_RETRIES``: additional attempts after a failed
+    checkpoint read/write (default 2 — three attempts total)."""
+    return max(0, _env_int("FF_CKPT_RETRIES", 2))
+
+
+def ckpt_backoff_s() -> float:
+    """``FF_CKPT_BACKOFF_S``: base delay of the exponential backoff
+    between checkpoint retries (default 0.2 s, doubling per attempt,
+    capped at 30 s)."""
+    try:
+        return max(0.0, float(os.environ.get("FF_CKPT_BACKOFF_S", "") or 0.2))
+    except ValueError:
+        return 0.2
+
+
+# ----------------------------------------------------------------------
+# non-finite step guard (host half — the select lives in the jitted step)
+# ----------------------------------------------------------------------
+
+class NonFiniteGuard:
+    """Host-side bookkeeping for the device-side skip.  Created at
+    ``compile()`` when ``FF_SKIP_NONFINITE`` is set; the jitted step
+    does the actual restore (model.py ``_build_train_step``), this
+    object just narrates drains and escalates."""
+
+    METRIC_KEYS = GUARD_METRIC_KEYS
+
+    def __init__(self, model, limit: int, log=None):
+        self.model = model
+        self.limit = int(limit)
+        self.log = log  # EventLog or None (guard works untraced)
+        self.total_skipped = 0
+        # live run length at the last drain — re-seeds a freshly
+        # created metric accumulator (model.reset_metrics discards the
+        # old one) so a NaN streak spanning resets still escalates
+        self.consec = 0
+
+    def on_drain(self, skipped: float, consec: float, steps: float,
+                 step_idx: int) -> None:
+        """Receives the guard entries popped off the drained metric
+        vector: skipped-step count in the window and the consecutive
+        run length at the window's end (preserved across drains)."""
+        self.consec = int(consec)
+        if skipped > 0:
+            self.total_skipped += int(skipped)
+            if self.log is not None:
+                self.log.event("step_skipped", step=step_idx,
+                               count=int(skipped),
+                               consecutive=int(consec),
+                               window_steps=int(steps),
+                               total=self.total_skipped)
+                self.log.flush()
+        if self.limit and consec >= self.limit:
+            raise NonFiniteEscalationError(
+                f"{int(consec)} consecutive non-finite steps skipped "
+                f"(limit FF_SKIP_NONFINITE={self.limit}) at step "
+                f"{step_idx} — the divergence is persistent; stopping "
+                "so the last good checkpoint stays good")
+
+
+# ----------------------------------------------------------------------
+# preemption (SIGTERM/SIGINT)
+# ----------------------------------------------------------------------
+
+class PreemptionHandler:
+    """Context manager turning SIGTERM/SIGINT into a cooperative flag.
+
+    Installed around the elastic loop; the loop polls ``requested`` at
+    step boundaries (one attribute read — signals can land mid-dispatch
+    where only Python-level cooperation is safe).  Previous handlers are
+    restored on exit.  Outside the main thread (where CPython refuses
+    ``signal.signal``) it degrades to an inert handler with a warning.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+
+    def _on_signal(self, signum, frame) -> None:
+        self.requested = True
+        self.signum = signum
+
+    def __enter__(self) -> "PreemptionHandler":
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            except ValueError:  # not the main thread
+                warnings.warn(
+                    "PreemptionHandler: cannot install signal handlers "
+                    "outside the main thread — preemption saves disabled "
+                    "for this loop", RuntimeWarning)
+                break
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        self._prev.clear()
+        return False
+
+
+# ----------------------------------------------------------------------
+# retrying checkpoint I/O
+# ----------------------------------------------------------------------
+
+def with_ckpt_retries(fn: Callable[[], Any], *, model=None,
+                      site: str = "ckpt_save", path: str = "",
+                      retries: Optional[int] = None,
+                      base_delay: Optional[float] = None,
+                      sleep: Callable[[float], None] = time.sleep) -> Any:
+    """Run checkpoint I/O with the chaos choke point and bounded
+    exponential backoff on OSError (the class covering disk-full,
+    flaky NFS/GCS fuse mounts, and the injected ``io_error``).
+
+    Each attempt re-enters the chaos point, so retry behavior itself is
+    injectable: ``ckpt_save:1=io_error`` fails attempt 1 and lets the
+    retry succeed.  Every retried attempt emits a ``ckpt_retry`` event.
+    Non-OSError failures propagate immediately — retrying a logic error
+    only hides it.
+    """
+    chaos = getattr(model, "_chaos", None) if model is not None else None
+    log = getattr(model, "_telemetry", None) if model is not None else None
+    n = ckpt_retries() if retries is None else max(0, int(retries))
+    base = ckpt_backoff_s() if base_delay is None else float(base_delay)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            if chaos is not None:
+                chaos.fire(site, model=model)
+            return fn()
+        except OSError as e:
+            if attempt > n:
+                raise
+            delay = min(base * (2 ** (attempt - 1)), MAX_BACKOFF_S)
+            if log is not None:
+                log.event("ckpt_retry", site=site, attempt=attempt,
+                          error=f"{type(e).__name__}: {e}",
+                          retry_in_s=round(delay, 3), path=path)
+                log.flush()
+            sleep(delay)
+
+
+# ----------------------------------------------------------------------
+# resume marker (step-granular elastic resume)
+# ----------------------------------------------------------------------
+
+def write_resume_meta(directory: str, **fields: Any) -> None:
+    """Atomically write ``resume_meta.json`` next to the checkpoints:
+    the step/steps-per-epoch record the resume math validates against
+    (and the marker a preemption leaves behind)."""
+    path = os.path.join(directory, RESUME_META_FILE)
+    rec = dict(fields)
+    rec["unix_time"] = time.time()
+    tmp = f"{path}.tmp-{os.getpid()}"
+    os.makedirs(directory, exist_ok=True)
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def read_resume_meta(directory: str) -> Optional[Dict[str, Any]]:
+    """The resume marker, or None (fresh dir / pre-marker checkpoint /
+    corrupt file — a kill can race the atomic replace's window)."""
+    try:
+        with open(os.path.join(directory, RESUME_META_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -1,0 +1,10 @@
+"""Test-harness subsystems that ship with the framework.
+
+``chaos`` is the deterministic fault injector (``FF_CHAOS``) that
+exercises the recovery layer in ``runtime/resilience.py``; it lives in
+the package (not under tests/) because chaos runs are a supported
+production debugging mode — the same spec that drives CI drives a
+staging pod.
+"""
+
+from . import chaos  # noqa: F401

@@ -1,0 +1,135 @@
+"""Chaos smoke: the acceptance scenario for the recovery layer, as a CLI.
+
+One seeded ``FF_CHAOS`` run injects a NaN step, a mid-epoch SIGTERM, and
+a failing checkpoint write; the resumed run must finish with parameters
+BITWISE-equal to an uninterrupted baseline, leave no partial checkpoint
+file behind, and the trace must narrate every recovery
+(``fault_injected`` / ``step_skipped`` / ``preemption_save`` /
+``ckpt_retry``).  Run by ``test.sh``; also a handy pod-shell sanity
+check after touching the recovery layer.
+
+Usage:
+    python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+# Both trajectories (baseline AND victim) carry the NaN injection: the
+# guard's skip is deterministic, so the runs stay bitwise-comparable —
+# only the preemption + checkpoint fault are exclusive to the victim.
+NAN_SPEC = "step:2=nan_loss"
+VICTIM_SPEC = NAN_SPEC + ";step:4=sigterm;ckpt_save:1=io_error"
+EPOCHS = 3
+
+
+def _build():
+    import numpy as np
+
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=16)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 8), nchw=False, name="input")
+    t = m.dense(inp, 16, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.softmax(t, name="sm")
+    m.compile(ff.AdamOptimizer(alpha=0.01),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=9)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((48, 8), dtype=np.float32)
+    y = rng.integers(0, 4, size=(48, 1), dtype=np.int32)
+    dl = ff.DataLoader(m, {inp: x}, y, seed=5)
+    return m, dl
+
+
+def _phase(env: dict):
+    """Reset the telemetry singleton and apply this phase's env."""
+    from ..observability import events
+
+    events.reset_active()
+    for k in ("FF_CHAOS", "FF_TELEMETRY", "FF_TELEMETRY_FILE"):
+        os.environ.pop(k, None)
+    os.environ.update(env)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workdir", required=True,
+                   help="scratch dir for checkpoints + traces")
+    args = p.parse_args(argv)
+    os.makedirs(args.workdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["FF_SKIP_NONFINITE"] = "5"
+    os.environ["FF_CKPT_BACKOFF_S"] = "0.01"
+
+    import numpy as np
+
+    from ..observability import events
+    from ..runtime.elastic import elastic_train
+    from ..runtime.resilience import Preempted, read_resume_meta
+
+    wd = args.workdir
+    trace = os.path.join(wd, "victim_trace.jsonl")
+
+    # -- baseline: uninterrupted, same NaN injection ---------------------
+    _phase({"FF_CHAOS": NAN_SPEC})
+    mb, dlb = _build()
+    elastic_train(mb, dlb, epochs=EPOCHS,
+                  checkpoint_dir=os.path.join(wd, "base"))
+    base = np.asarray(mb.get_parameter("fc1", "kernel"))
+    assert mb._nonfinite_guard.total_skipped == 1, "baseline skip missing"
+    print(f"baseline: {mb._step_count} steps, 1 NaN step skipped",
+          flush=True)
+
+    # -- victim: + SIGTERM mid-epoch + failing checkpoint write ----------
+    _phase({"FF_CHAOS": VICTIM_SPEC, "FF_TELEMETRY": "1",
+            "FF_TELEMETRY_FILE": trace})
+    ck = os.path.join(wd, "ck")
+    mv, dlv = _build()
+    try:
+        elastic_train(mv, dlv, epochs=EPOCHS, checkpoint_dir=ck)
+        raise AssertionError("victim was not preempted")
+    except Preempted as e:
+        print(f"victim: preempted cleanly at step {e.step}", flush=True)
+    meta = read_resume_meta(ck)
+    assert meta and meta["step"] == mv._step_count, meta
+
+    # -- resume: chaos off, finish the job -------------------------------
+    _phase({})
+    mr, dlr = _build()
+    elastic_train(mr, dlr, epochs=EPOCHS, checkpoint_dir=ck)
+    events.reset_active()
+    got = np.asarray(mr.get_parameter("fc1", "kernel"))
+    assert mr._step_count == mb._step_count, \
+        (mr._step_count, mb._step_count)
+    assert (got == base).all(), "resumed params differ from baseline"
+    print(f"resume: finished at step {mr._step_count}, params "
+          "bitwise-equal to uninterrupted baseline", flush=True)
+
+    # -- no corrupt/partial checkpoint artifacts -------------------------
+    stray = glob.glob(os.path.join(wd, "**", "*.tmp-*"), recursive=True)
+    assert not stray, f"partial checkpoint files left behind: {stray}"
+
+    # -- the trace narrates every recovery -------------------------------
+    names = [json.loads(l).get("name")
+             for l in open(trace) if l.strip()]
+    for ev in ("fault_injected", "step_skipped", "preemption_save",
+               "ckpt_retry"):
+        assert ev in names, f"{ev} missing from trace (saw {set(names)})"
+    injected = names.count("fault_injected")
+    print(f"trace: {injected} faults injected, all recovery events "
+          f"present ({trace})", flush=True)
+    print("CHAOS SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,38 @@ def _observability():
     return ", ".join(bits)
 
 
+def _resilience():
+    # Effective chaos/recovery env as chaos.py/resilience.py will see
+    # it.  An invalid FF_CHAOS spec fails HERE (required-style error in
+    # the detail) instead of silently injecting nothing at train time;
+    # the checkpoint dir gets a writability probe — a read-only dir
+    # otherwise fails at the first save, hours into the run.
+    from ..runtime import resilience
+    from ..testing import chaos
+
+    spec = os.environ.get("FF_CHAOS", "")
+    bits = []
+    if spec:
+        # raises ValueError on a bad spec -> the check reports it
+        bits.append(f"FF_CHAOS={chaos.ChaosMonkey(spec).describe()}, "
+                    f"seed={os.environ.get('FF_CHAOS_SEED', '0')}")
+    else:
+        bits.append("FF_CHAOS=off")
+    nf = resilience.nonfinite_limit()
+    bits.append(f"FF_SKIP_NONFINITE={nf if nf else 'off'}")
+    bits.append(f"FF_CKPT_RETRIES={resilience.ckpt_retries()}")
+    ckpt_dir = os.environ.get("FF_CKPT_DIR", "")
+    if ckpt_dir:
+        d = os.path.abspath(ckpt_dir)
+        probe = d if os.path.isdir(d) else (os.path.dirname(d) or ".")
+        if not os.path.isdir(probe):
+            raise FileNotFoundError(f"FF_CKPT_DIR parent missing: {probe}")
+        if not os.access(probe, os.W_OK):
+            raise PermissionError(f"FF_CKPT_DIR not writable: {d}")
+        bits.append(f"FF_CKPT_DIR={d} (writable)")
+    return ", ".join(bits)
+
+
 def _cpu_train():
     import jax
 
@@ -153,6 +185,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     plan += [("native libs", _native_libs, False),
              ("optional deps", _optional_deps, False),
              ("observability", _observability, False),
+             ("resilience", _resilience, False),
              ("cpu training", _cpu_train, True)]
 
     # print each line as its check completes — the slow checks (90s

@@ -176,6 +176,46 @@ def render_report(records: List[Dict[str, Any]]) -> str:
                                  f"({worst_key[0]} {worst_key[1]})")
         lines.append("")
 
+    # ---- recovery (resilience.py narration) ---------------------------
+    injected = events.get("fault_injected", [])
+    skipped = events.get("step_skipped", [])
+    preempts = events.get("preemption_save", [])
+    retries = events.get("ckpt_retry", [])
+    hangs = events.get("device_hang", [])
+    if injected or skipped or preempts or retries or hangs:
+        lines.append("## Recovery")
+        lines.append("")
+        if injected:
+            faults = ", ".join(
+                f"{e.get('attrs', {}).get('site', '?')}:"
+                f"{e.get('attrs', {}).get('trigger', '?')}="
+                f"{e.get('attrs', {}).get('fault', '?')}" for e in injected)
+            lines.append(f"- chaos-injected faults: {len(injected)} "
+                         f"({faults})")
+        if skipped:
+            total = sum(int(e.get("attrs", {}).get("count", 0))
+                        for e in skipped)
+            worst = max(int(e.get("attrs", {}).get("consecutive", 0))
+                        for e in skipped)
+            lines.append(f"- non-finite steps skipped: {total} "
+                         f"(worst run {worst} consecutive) — params "
+                         "restored in-step, training continued")
+        if retries:
+            lines.append(f"- checkpoint I/O retries: {len(retries)} "
+                         f"(last: {_fmt_attrs(retries[-1].get('attrs', {}))})")
+        if preempts:
+            a = preempts[-1].get("attrs", {})
+            lines.append(f"- preemption saves: {len(preempts)} (last at "
+                         f"step {a.get('step', '?')}, signal "
+                         f"{a.get('signum', '?')}) — resume with the same "
+                         "command")
+        if hangs:
+            a = hangs[-1].get("attrs", {})
+            lines.append(f"- device hangs detected: {len(hangs)} "
+                         f"({a.get('stranded', '?')} watchdog worker(s) "
+                         "stranded)")
+        lines.append("")
+
     # ---- heartbeat / phases -------------------------------------------
     bench = events.get("bench_phase", [])
     if bench:

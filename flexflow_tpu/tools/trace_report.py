@@ -191,6 +191,32 @@ def render_report(records: List[Dict[str, Any]], top_k: int = 8) -> str:
             lines.append(f"| {op} | {fwd:.3f} | {bwd:.3f} | {tot:.3f} |")
         lines.append("")
 
+    # ---- resilience (chaos + recovery narration) ----------------------
+    resil_names = ("fault_injected", "step_skipped", "preemption_save",
+                   "ckpt_retry", "device_hang")
+    resil = [(n, events[n]) for n in resil_names if events.get(n)]
+    if resil:
+        lines.append("## Resilience")
+        lines.append("")
+        lines.append("| event | count | last |")
+        lines.append("|---|---|---|")
+        for name, evs in resil:
+            a = evs[-1].get("attrs", {})
+            detail = " ".join(f"{k}={a[k]}" for k in sorted(a))
+            lines.append(f"| {name} | {len(evs)} | {detail} |")
+        lines.append("")
+        injected = events.get("fault_injected", [])
+        if injected:
+            lines.append("injected faults, in order:")
+            lines.append("")
+            for e in injected:
+                a = e.get("attrs", {})
+                lines.append(f"- `{a.get('site', '?')}:"
+                             f"{a.get('trigger', '?')}` -> "
+                             f"{a.get('fault', '?')} "
+                             f"(t={float(e.get('ts', 0.0)):.2f}s)")
+            lines.append("")
+
     # ---- bench phases -------------------------------------------------
     bench = events.get("bench_phase", [])
     if bench:

@@ -59,6 +59,17 @@ python -m flexflow_tpu.tools.search_report \
   || { echo "search smoke: strategy diff failed"; exit 1; }
 echo "search smoke: OK ($(wc -l < "$STRACE") trace records)"
 
+# Chaos smoke: one seeded FF_CHAOS run injects a NaN step, a mid-epoch
+# SIGTERM, and a failing checkpoint write; the resumed run must finish
+# bitwise-equal to an uninterrupted baseline and the trace must narrate
+# every recovery (docs/robustness.md).
+python -m flexflow_tpu.testing.chaos_smoke --workdir "$SMOKE_DIR/chaos" \
+  || { echo "chaos smoke: FAILED"; exit 1; }
+python -m flexflow_tpu.tools.trace_report "$SMOKE_DIR/chaos/victim_trace.jsonl" \
+  | grep -q "## Resilience" \
+  || { echo "chaos smoke: trace report missing resilience section"; exit 1; }
+echo "chaos smoke: OK"
+
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \
             examples/keras/seq_mnist_mlp.py \

@@ -6,17 +6,21 @@ and a wedged device must surface as DeviceHangError instead of an
 infinite block.
 """
 
+import json
+import threading
 import time
 
 import numpy as np
 import pytest
 
 import flexflow_tpu as ff
+from flexflow_tpu.observability import events
 from flexflow_tpu.runtime.elastic import (DeviceHangError, StepWatchdog,
                                           elastic_train)
+from flexflow_tpu.runtime.resilience import ResumeMismatchError
 
 
-def _build(opt="adam"):
+def _build(opt="adam", n_samples=48):
     cfg = ff.FFConfig(batch_size=16)
     m = ff.FFModel(cfg)
     inp = m.create_tensor((16, 8), nchw=False, name="input")
@@ -28,8 +32,8 @@ def _build(opt="adam"):
     m.compile(optimizer, "sparse_categorical_crossentropy", ["accuracy"])
     m.init_layers(seed=9)
     rng = np.random.default_rng(3)
-    x = rng.standard_normal((48, 8), dtype=np.float32)
-    y = rng.integers(0, 4, size=(48, 1), dtype=np.int32)
+    x = rng.standard_normal((n_samples, 8), dtype=np.float32)
+    y = rng.integers(0, 4, size=(n_samples, 1), dtype=np.int32)
     dl = ff.DataLoader(m, {inp: x}, y, seed=5)
     return m, dl
 
@@ -77,6 +81,57 @@ def test_failure_saves_then_propagates(tmp_path, devices):
     assert 0 < ran < 4  # resumed from the mid-failure save
 
 
+def test_step_granular_resume_mid_epoch(tmp_path, devices):
+    """A failure between mid-epoch saves resumes at the exact STEP (not
+    the epoch boundary) and continues bitwise-identically."""
+    mb, dlb = _build()
+    elastic_train(mb, dlb, epochs=2, checkpoint_dir=str(tmp_path / "base"))
+    base = np.asarray(mb.get_parameter("fc1", "kernel"))
+
+    m, dl = _build()
+    boom = RuntimeError("mid-epoch crash")
+    calls = {"n": 0}
+
+    real_next = type(dl).next_batch
+
+    def crashing_next(self, ff_=None):
+        calls["n"] += 1
+        if calls["n"] == 5:  # step 4: one step into epoch 2
+            raise boom
+        return real_next(self, ff_)
+
+    dl.next_batch = crashing_next.__get__(dl)
+    with pytest.raises(RuntimeError, match="mid-epoch crash"):
+        elastic_train(m, dl, epochs=2, checkpoint_dir=str(tmp_path / "ck"),
+                      save_every_steps=1)
+
+    m2, dl2 = _build()
+    ran = elastic_train(m2, dl2, epochs=2,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        save_every_steps=1)
+    assert ran == 1  # only the interrupted epoch re-enters the loop
+    assert m2._step_count == 6
+    got = np.asarray(m2.get_parameter("fc1", "kernel"))
+    assert (got == base).all()  # bitwise, not just allclose
+
+
+def test_resume_mismatch_named_error_and_recompute(tmp_path, devices):
+    m, dl = _build()
+    elastic_train(m, dl, epochs=1, checkpoint_dir=str(tmp_path / "ck"))
+
+    # dataset grew: 48 -> 64 samples = 3 -> 4 steps/epoch
+    m2, dl2 = _build(n_samples=64)
+    with pytest.raises(ResumeMismatchError, match="3 steps/epoch"):
+        elastic_train(m2, dl2, epochs=2, checkpoint_dir=str(tmp_path / "ck"))
+
+    m3, dl3 = _build(n_samples=64)
+    with pytest.warns(RuntimeWarning, match="recomputing"):
+        ran = elastic_train(m3, dl3, epochs=2,
+                            checkpoint_dir=str(tmp_path / "ck"),
+                            on_steps_mismatch="recompute")
+    assert ran > 0
+
+
 def test_watchdog_detects_hang():
     wd = StepWatchdog(timeout=0.3)
     t0 = time.perf_counter()
@@ -90,3 +145,32 @@ def test_watchdog_passes_through_results_and_errors():
     assert wd.run(lambda: 42) == 42
     with pytest.raises(ValueError):
         wd.run(lambda: (_ for _ in ()).throw(ValueError("x")))
+
+
+def test_watchdog_names_threads_and_narrates_hangs(tmp_path, monkeypatch):
+    """Stranded workers carry ff-watchdog-* names, a device_hang event
+    lands in the trace before the raise, and accumulated hangs warn."""
+    trace = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", str(trace))
+    events.reset_active()
+    StepWatchdog._stranded.clear()
+    release = threading.Event()
+    try:
+        wd = StepWatchdog(timeout=0.05)
+        with pytest.raises(DeviceHangError, match="ff-watchdog-"):
+            wd.run(release.wait)
+        stranded = [t for t in threading.enumerate()
+                    if t.name.startswith("ff-watchdog-")]
+        assert stranded  # the worker is pinned, and identifiable by name
+        # two more hangs push past the stranded-thread warning threshold
+        with pytest.warns(RuntimeWarning, match="stranded"):
+            for _ in range(StepWatchdog.STRANDED_WARN_AT - 1):
+                with pytest.raises(DeviceHangError):
+                    wd.run(release.wait)
+    finally:
+        release.set()  # unpin the workers
+        StepWatchdog._stranded.clear()
+        events.reset_active()
+    names = [json.loads(l).get("name") for l in open(trace) if l.strip()]
+    assert names.count("device_hang") == StepWatchdog.STRANDED_WARN_AT
