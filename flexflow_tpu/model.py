@@ -2123,6 +2123,57 @@ class FFModel:
             tree = self._he_dev_cache[2]
         return tree
 
+    # ------------------------------------------------------------------
+    # decode entry points — shared by generate()/beam_search() and the
+    # serving engine (flexflow_tpu/serving/), which composes them into
+    # its own jitted prefill/step functions over a slot-based kv pool
+    # ------------------------------------------------------------------
+    def resolve_decode_inputs(self, tokens_input: Optional[Tensor] = None,
+                              positions_input: Optional[Tensor] = None):
+        """Resolve the (tokens, positions) graph inputs fed one token at
+        a time during decoding.  Explicit ``is None`` tests throughout: a
+        falsy-but-valid Tensor handle must never be silently replaced by
+        the default."""
+        tok_t = tokens_input if tokens_input is not None \
+            else self.input_tensors[0]
+        pos_t = positions_input
+        if pos_t is None and tokens_input is None \
+                and len(self.input_tensors) > 1:
+            # transformer layout (tokens, positions) — only guessed when
+            # the tokens input was also defaulted
+            pos_t = self.input_tensors[1]
+        return tok_t, pos_t
+
+    def init_decode_caches(self, batch_size: int, max_len: int, skip=()):
+        """Fresh decode-cache pytree: one entry per op, ``batch_size``
+        rows, ``max_len`` sequence positions (trace-safe)."""
+        return {op.name: op.init_cache(batch_size, max_len,
+                                       self.compute_dtype)
+                for op in self.ops if op.name not in skip}
+
+    def decode_step(self, params, stats, caches, cur, pos, tok_t, pos_t,
+                    pre_env=None, skip=()):
+        """One single-token decode step: feed token ids ``cur`` (B,)
+        int32 at position ``pos`` and return (probs (B, V) float32, new
+        caches).  ``pos`` is a scalar, or a per-row (B,) vector when the
+        rows sit at DIFFERENT sequence positions — the serving engine's
+        continuous batch, where each slot carries its own write offset
+        and causal-mask length.  Trace-safe: generate()/beam_search()
+        call this inside their jitted scans, the serving engine inside
+        its jitted prefill/step functions."""
+        B = cur.shape[0]
+        batch = {f"in_{tok_t.guid}": cur[:, None]}
+        if pos_t is not None:
+            p = pos if jnp.ndim(pos) else jnp.full((B,), pos, jnp.int32)
+            batch[f"in_{pos_t.guid}"] = p[:, None]
+        ctx = FwdCtx(training=False, rng=jax.random.key(self.config.seed),
+                     stats_in=stats)
+        env, caches = self._run_graph_decode(params, caches, batch, pos,
+                                             ctx, pre_env=pre_env,
+                                             skip=skip)
+        probs = env[self.final_tensor().guid][:, -1, :].astype(jnp.float32)
+        return probs, caches
+
     def _check_position_table(self, pos_t, s_max: int) -> None:
         """jnp.take clamps OOB position lookups under jit — catch an
         overlong request instead of degrading silently."""
@@ -2202,17 +2253,10 @@ class FFModel:
         N = int(max_new_tokens)
         if N <= 0:
             return np.zeros((B, 0), np.int32)
-        tok_t = tokens_input or self.input_tensors[0]
-        pos_t = positions_input
-        if pos_t is None and tokens_input is None \
-                and len(self.input_tensors) > 1:
-            # transformer layout (tokens, positions) — only guessed when
-            # the tokens input was also defaulted
-            pos_t = self.input_tensors[1]
+        tok_t, pos_t = self.resolve_decode_inputs(tokens_input,
+                                                  positions_input)
         s_max = P + N
         self._check_position_table(pos_t, s_max)
-        cdtype = self.compute_dtype
-        final_guid = self.final_tensor().guid
         sampled = float(temperature) > 0.0
         # bad knob values fail loudly even when greedy ignores them ...
         if top_k is not None and int(top_k) < 1:
@@ -2231,16 +2275,9 @@ class FFModel:
             caches, tok, pos, key = carry
             feed_tok, use_feed = inp
             cur = jnp.where(use_feed, feed_tok, tok)          # (B,)
-            batch = {f"in_{tok_t.guid}": cur[:, None]}
-            if pos_t is not None:
-                batch[f"in_{pos_t.guid}"] = jnp.full((B, 1), pos, jnp.int32)
-            ctx = FwdCtx(training=False,
-                         rng=jax.random.key(self.config.seed),
-                         stats_in=stats)
-            env, caches = self._run_graph_decode(params, caches, batch,
-                                                 pos, ctx, pre_env=pre_env,
-                                                 skip=static_names)
-            probs = env[final_guid][:, -1, :].astype(jnp.float32)  # (B, V)
+            probs, caches = self.decode_step(
+                params, stats, caches, cur, pos, tok_t, pos_t,
+                pre_env=pre_env, skip=static_names)           # (B, V)
             if sampled:
                 logits = jnp.log(probs + 1e-9)
                 if t_k is not None or t_p is not None:
@@ -2283,8 +2320,8 @@ class FFModel:
             def run(params, stats, extra, feed, use, key0, temp):
                 pre_env = self._prefill_static(params, stats, extra,
                                                extra_guids, static_ops)
-                caches0 = {op.name: op.init_cache(B, s_max, cdtype)
-                           for op in self.ops if op.name not in static_names}
+                caches0 = self.init_decode_caches(B, s_max,
+                                                  skip=static_names)
                 carry0 = (caches0, jnp.zeros((B,), jnp.int32),
                           jnp.zeros((), jnp.int32), key0)
                 _, outs = jax.lax.scan(
@@ -2332,16 +2369,11 @@ class FFModel:
         if N <= 0:
             return (np.zeros((B, K, 0), np.int32),
                     np.zeros((B, K), np.float32))
-        tok_t = tokens_input or self.input_tensors[0]
-        pos_t = positions_input
-        if pos_t is None and tokens_input is None \
-                and len(self.input_tensors) > 1:
-            pos_t = self.input_tensors[1]
+        tok_t, pos_t = self.resolve_decode_inputs(tokens_input,
+                                                  positions_input)
         s_max = P + N
         self._check_position_table(pos_t, s_max)
         BK = B * K
-        cdtype = self.compute_dtype
-        final_guid = self.final_tensor().guid
 
         extra_guids = {t.guid for t in (extra_inputs or {})}
         static_ops, static_names = self._static_decode_ops(extra_guids)
@@ -2351,17 +2383,9 @@ class FFModel:
             feed_tok, use_feed, do_expand = inp           # (B,), scalars
             cur = jnp.where(use_feed,
                             jnp.repeat(feed_tok, K), last)    # (BK,)
-            batch = {f"in_{tok_t.guid}": cur[:, None]}
-            if pos_t is not None:
-                batch[f"in_{pos_t.guid}"] = jnp.full((BK, 1), pos,
-                                                     jnp.int32)
-            ctx = FwdCtx(training=False,
-                         rng=jax.random.key(self.config.seed),
-                         stats_in=stats)
-            env, caches = self._run_graph_decode(params, caches, batch,
-                                                 pos, ctx, pre_env=pre_env,
-                                                 skip=static_names)
-            probs = env[final_guid][:, -1, :].astype(jnp.float32)
+            probs, caches = self.decode_step(
+                params, stats, caches, cur, pos, tok_t, pos_t,
+                pre_env=pre_env, skip=static_names)
             logp = jnp.log(probs + 1e-30)                  # (BK, V)
             V = logp.shape[-1]
             if eos_id is not None:
@@ -2408,8 +2432,8 @@ class FFModel:
                 pre_env = self._prefill_static(params, stats, extra,
                                                extra_guids, static_ops,
                                                repeat=K)
-                caches0 = {op.name: op.init_cache(BK, s_max, cdtype)
-                           for op in self.ops if op.name not in static_names}
+                caches0 = self.init_decode_caches(BK, s_max,
+                                                  skip=static_names)
                 # beams 1..K-1 start at -inf so the first free step
                 # expands from beam 0 alone
                 scores0 = jnp.tile(

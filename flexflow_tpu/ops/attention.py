@@ -189,14 +189,27 @@ class MultiHeadAttention(Op):
         v = self._proj(params, v_in, "wv", "bv")
         split = lambda t: t.reshape(B, S1, H, D).transpose(0, 2, 1, 3)
         qh, kh, vh = split(q), split(k), split(v)            # (B, H, 1, D)
-        ck = lax.dynamic_update_slice(
-            cache["k"], kh.astype(cache["k"].dtype), (0, 0, pos, 0))
-        cv = lax.dynamic_update_slice(
-            cache["v"], vh.astype(cache["v"].dtype), (0, 0, pos, 0))
+        if jnp.ndim(pos):
+            # per-row positions (the serving engine's continuous batch):
+            # each row scatters its k/v into its own slot offset and
+            # masks by its own prefix length — rows of the SAME batch
+            # sit at different sequence positions mid-flight
+            rows = jnp.arange(B)
+            ck = cache["k"].at[rows, :, pos, :].set(
+                kh[:, :, 0, :].astype(cache["k"].dtype))
+            cv = cache["v"].at[rows, :, pos, :].set(
+                vh[:, :, 0, :].astype(cache["v"].dtype))
+            pos_b = pos[:, None, None, None]
+        else:
+            ck = lax.dynamic_update_slice(
+                cache["k"], kh.astype(cache["k"].dtype), (0, 0, pos, 0))
+            cv = lax.dynamic_update_slice(
+                cache["v"], vh.astype(cache["v"].dtype), (0, 0, pos, 0))
+            pos_b = pos
         scale = 1.0 / math.sqrt(D)
         scores = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
                             ck.astype(jnp.float32)) * scale
-        valid = jnp.arange(ck.shape[2])[None, None, None, :] <= pos
+        valid = jnp.arange(ck.shape[2])[None, None, None, :] <= pos_b
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs,

@@ -144,8 +144,11 @@ class LSTM(Op):
         bias = params["bias"].astype(jnp.float32)
         h_dim = w_ih.shape[1] // 4
         if self.has_state_inputs:
-            h0 = jnp.where(pos == 0, xs[1].astype(jnp.float32), cache["h"])
-            c0 = jnp.where(pos == 0, xs[2].astype(jnp.float32), cache["c"])
+            # pos may be a per-row (B,) vector (serving engine) — align
+            # it against the (B, H) state for broadcasting
+            at0 = (pos == 0)[:, None] if jnp.ndim(pos) else pos == 0
+            h0 = jnp.where(at0, xs[1].astype(jnp.float32), cache["h"])
+            c0 = jnp.where(at0, xs[2].astype(jnp.float32), cache["c"])
         else:
             h0, c0 = cache["h"], cache["c"]
         z = jnp.dot(x[:, 0, :], w_ih, preferred_element_type=acc)

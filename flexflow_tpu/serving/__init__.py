@@ -1,0 +1,31 @@
+"""Continuous-batching inference serving (docs/serving.md).
+
+Layers:
+
+* ``config``  — ``ServeConfig`` / ``FF_SERVE_*`` env knobs (stdlib-only)
+* ``queue``   — ``InferenceRequest`` futures + priority ``RequestQueue``
+                (stdlib-only)
+* ``engine``  — ``InferenceEngine``: slot-based kv pool + the
+                continuous-batching decode loop (imports jax)
+* ``api``     — ``ServingAPI``: stdlib ThreadingHTTPServer front end
+
+``InferenceEngine`` is imported lazily so stdlib-only consumers
+(doctor, report CLIs) can read the config layer without touching jax.
+"""
+
+from .config import ServeConfig
+from .queue import (InferenceRequest, RequestQueue, ServeError,
+                    ServeTimeout)
+
+__all__ = ["InferenceEngine", "InferenceRequest", "RequestQueue",
+           "ServeConfig", "ServeError", "ServeTimeout", "ServingAPI"]
+
+
+def __getattr__(name):
+    if name == "InferenceEngine":
+        from .engine import InferenceEngine
+        return InferenceEngine
+    if name == "ServingAPI":
+        from .api import ServingAPI
+        return ServingAPI
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

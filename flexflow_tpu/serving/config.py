@@ -1,0 +1,145 @@
+"""Serving configuration (``FF_SERVE_*`` environment variables).
+
+STDLIB-ONLY on purpose: ``tools/doctor.py`` parses the effective serving
+env on hosts with no accelerator, and the HTTP front end reads defaults
+before any model exists.  A typo'd env value raises ValueError naming
+the variable — a serving knob silently falling back to its default is
+worse than a crash at startup.
+
+Knobs (env var -> field):
+
+  FF_SERVE_MAX_BATCH      max_batch        decode slots in the continuous
+                                           batch (device batch dim; static)
+  FF_SERVE_MAX_SEQ        max_seq          kv-cache positions per slot —
+                                           every request needs
+                                           prompt_len + max_new_tokens <= max_seq
+  FF_SERVE_BUCKETS        buckets          comma-separated ascending prompt
+                                           buckets, e.g. "8,16,32"; prompts
+                                           pad up to the smallest bucket that
+                                           fits so each bucket jit-compiles
+                                           exactly once (default: powers of
+                                           two from 8 up to max_seq)
+  FF_SERVE_MAX_NEW_TOKENS max_new_tokens   default + cap for per-request
+                                           max_new_tokens
+  FF_SERVE_QUEUE_TIMEOUT  queue_timeout_s  default seconds a request may wait
+                                           for admission before failing with
+                                           status "timeout" (0: wait forever)
+  FF_SERVE_HOST           host             HTTP bind host
+  FF_SERVE_PORT           port             HTTP bind port (0: ephemeral)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+ENV_PREFIX = "FF_SERVE_"
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer")
+    if v < lo:
+        raise ValueError(f"{name}={v} must be >= {lo}")
+    return v
+
+
+def _env_float(name: str, default: float, lo: float = 0.0) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not a number")
+    if v < lo:
+        raise ValueError(f"{name}={v} must be >= {lo}")
+    return v
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    max_batch: int = 8
+    max_seq: int = 128
+    buckets: Tuple[int, ...] = ()       # () -> power-of-two ladder
+    max_new_tokens: int = 32
+    queue_timeout_s: float = 30.0
+    poll_interval_s: float = 0.02      # idle-loop wait granularity
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_seq < 2:
+            raise ValueError(f"max_seq must be >= 2, got {self.max_seq}")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {self.max_new_tokens}")
+        self.buckets = tuple(int(b) for b in self.buckets)
+        if any(b < 1 for b in self.buckets):
+            raise ValueError(f"buckets must be positive: {self.buckets}")
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"buckets must be strictly ascending: "
+                             f"{self.buckets}")
+        if self.buckets and self.buckets[-1] >= self.max_seq:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} leaves no room for a "
+                f"generated token (max_seq={self.max_seq})")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        """Build from ``FF_SERVE_*`` env vars; explicit kwargs win.
+        Raises ValueError naming the offending variable."""
+        kw = dict(
+            max_batch=_env_int("FF_SERVE_MAX_BATCH", cls.max_batch),
+            max_seq=_env_int("FF_SERVE_MAX_SEQ", cls.max_seq, lo=2),
+            max_new_tokens=_env_int("FF_SERVE_MAX_NEW_TOKENS",
+                                    cls.max_new_tokens),
+            queue_timeout_s=_env_float("FF_SERVE_QUEUE_TIMEOUT",
+                                       cls.queue_timeout_s),
+            host=os.environ.get("FF_SERVE_HOST", cls.host),
+            port=_env_int("FF_SERVE_PORT", cls.port, lo=0),
+        )
+        raw = os.environ.get("FF_SERVE_BUCKETS", "")
+        if raw:
+            try:
+                kw["buckets"] = tuple(int(p) for p in raw.split(",") if p)
+            except ValueError:
+                raise ValueError(f"FF_SERVE_BUCKETS={raw!r}: expected "
+                                 "comma-separated integers")
+        kw.update(overrides)
+        return cls(**kw)
+
+    def resolved_buckets(self) -> Tuple[int, ...]:
+        """The effective prompt-length buckets: the configured ones, or
+        a power-of-two ladder 8, 16, ... up to the largest power of two
+        strictly below ``max_seq`` (a prompt filling the whole cache
+        could not generate a single token)."""
+        if self.buckets:
+            return self.buckets
+        out, b = [], 8
+        while b < self.max_seq:
+            out.append(b)
+            b *= 2
+        return tuple(out) or (self.max_seq - 1,)
+
+    def bucket_for(self, prompt_len: int) -> Optional[int]:
+        """Smallest bucket that fits ``prompt_len`` (None: too long)."""
+        for b in self.resolved_buckets():
+            if prompt_len <= b:
+                return b
+        return None
+
+    def describe(self) -> str:
+        return (f"max_batch={self.max_batch} max_seq={self.max_seq} "
+                f"buckets={list(self.resolved_buckets())} "
+                f"max_new_tokens={self.max_new_tokens} "
+                f"queue_timeout={self.queue_timeout_s:g}s "
+                f"http={self.host}:{self.port}")

@@ -1,0 +1,434 @@
+"""Continuous-batching inference engine over a slot-based kv-cache pool.
+
+The reference FlexFlow is training-only; ``FFModel.generate()`` (the
+first inference surface here) is one-shot: it compiles a scan for ONE
+(B, P, N) shape and blocks the caller for the whole decode.  Serving
+heavy traffic needs the opposite: many callers, mixed prompt/output
+lengths, stable jitted shapes, and no head-of-line blocking.  This
+engine provides that with the classic TPU trick — keep every device
+shape STATIC and move all dynamism to the host:
+
+* A fixed pool of ``max_batch`` decode SLOTS.  The decode step is one
+  jitted function over the full (max_batch,) token/position vectors and
+  the pooled (max_batch, H, max_seq, D) kv caches — it compiles exactly
+  once, regardless of traffic.
+* Requests are ADMITTED AT TOKEN BOUNDARIES from a thread-safe priority
+  queue.  A free slot prefills the prompt — padded to a LENGTH BUCKET so
+  each bucket compiles once — then joins the running batch; rows of the
+  same device batch sit at different sequence positions (per-row ``pos``
+  vector, see ``FFModel.decode_step``).
+* A finished sequence RELEASES ITS SLOT MID-FLIGHT: the host-side active
+  mask stops collecting that lane, and the next admission overwrites the
+  slot's cache slice wholesale.  Stale lanes still compute (shapes are
+  static) but their causal masks zero their influence exactly, so greedy
+  per-request output is equal to a standalone ``generate()`` call.
+
+Observability (when the model was compiled with telemetry): per-request
+``serve_queue_wait`` / ``serve_prefill`` / ``serve_decode`` spans, a
+``serve_request_done`` event carrying TTFT/TPOT, ``serve_tokens`` /
+``serve_requests`` counters and a per-token-boundary
+``serve_batch_occupancy`` gauge — ``tools/serve_report.py`` folds them
+into latency percentiles and an occupancy timeline.
+
+Fault isolation: a request whose admission/prefill raises (including an
+``FF_CHAOS`` ``serve`` fault) fails ALONE — the batch loop and every
+other request keep running.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ServeConfig
+from .queue import (CANCELLED, DONE, ERROR, RUNNING, InferenceRequest,
+                    RequestQueue, ServeError)
+
+
+class _Slot:
+    """Host-side state of one running sequence."""
+
+    __slots__ = ("req", "pos", "t_first")
+
+    def __init__(self, req: InferenceRequest, pos: int, t_first: float):
+        self.req = req
+        self.pos = pos          # position the NEXT fed token occupies
+        self.t_first = t_first
+
+
+class InferenceEngine:
+    """Continuous-batching decode loop over a compiled ``FFModel``.
+
+    Usage::
+
+        engine = InferenceEngine(model, max_batch=8, max_seq=128)
+        with engine:                       # starts the loop thread
+            h = engine.submit([1, 2, 3], max_new_tokens=16)
+            tokens = h.result(timeout=30)  # (16,) int32
+
+    Decoding is greedy (argmax) — bitwise the same tokens as
+    ``model.generate(prompt[None], n)`` for every request, which is what
+    makes the batching transparent to callers.
+    """
+
+    def __init__(self, model, config: Optional[ServeConfig] = None,
+                 telemetry=None, **overrides):
+        assert getattr(model, "_compiled", False), \
+            "InferenceEngine needs a compiled model (call compile() first)"
+        self.model = model
+        self.config = config if config is not None \
+            else ServeConfig.from_env(**overrides)
+        self._tok_t, self._pos_t = model.resolve_decode_inputs()
+        fed = {self._tok_t.guid}
+        if self._pos_t is not None:
+            fed.add(self._pos_t.guid)
+        extra = [t for t in model.input_tensors if t.guid not in fed]
+        if extra:
+            raise ValueError(
+                f"serving: model has {len(extra)} extra graph input(s) "
+                f"beyond (tokens, positions) — seq2seq extra_inputs are "
+                f"not served; use FFModel.generate()")
+        model._check_position_table(self._pos_t, self.config.max_seq)
+
+        self._telemetry = telemetry if telemetry is not None \
+            else getattr(model, "_telemetry", None)
+        self._chaos = getattr(model, "_chaos", None)
+
+        B = self.config.max_batch
+        self._queue = RequestQueue()
+        self._slots: List[Optional[_Slot]] = [None] * B
+        self._toks = np.zeros(B, np.int32)   # last fed token per slot
+        self._pos = np.zeros(B, np.int32)    # its position per slot
+        self._caches = None                  # created lazily on device
+        self._prefill_fns: Dict[int, Any] = {}
+        self._step_fn = None
+        self._insert_fn = None
+        # donation keeps the pooled caches in-place on accelerators; the
+        # CPU backend would warn on every call
+        self._donate = jax.default_backend() != "cpu"
+
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._drain = True
+        # submits are accepted from construction (queueing before
+        # start() is legal — the loop admits once it runs); only stop()
+        # closes the door
+        self._accepting = True
+        self._admit_seq = 0
+        self._stats = dict(submitted=0, admitted=0, completed=0, failed=0,
+                           timeouts=0, cancelled=0, tokens_out=0,
+                           prefill_compiles=0, step_iterations=0,
+                           occupancy_sum=0, max_active=0)
+
+    # ------------------------------------------------------------------
+    # jitted device functions (static shapes; compiled once per engine /
+    # per prompt bucket)
+    # ------------------------------------------------------------------
+    def _get_step_fn(self):
+        if self._step_fn is None:
+            model, tok_t, pos_t = self.model, self._tok_t, self._pos_t
+
+            def step(params, stats, caches, toks, pos):
+                probs, caches = model.decode_step(
+                    params, stats, caches, toks, pos, tok_t, pos_t)
+                return caches, jnp.argmax(probs, axis=-1).astype(jnp.int32)
+
+            self._step_fn = jax.jit(
+                step, donate_argnums=(2,) if self._donate else ())
+        return self._step_fn
+
+    def _get_prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            model, tok_t, pos_t = self.model, self._tok_t, self._pos_t
+            max_seq = self.config.max_seq
+
+            def prefill(params, stats, toks):        # toks (1, bucket)
+                caches = model.init_decode_caches(1, max_seq)
+
+                def body(caches, t):
+                    probs, caches = model.decode_step(
+                        params, stats, caches, toks[:, t], t, tok_t, pos_t)
+                    return caches, jnp.argmax(probs, -1).astype(jnp.int32)
+
+                caches, outs = jax.lax.scan(body, caches,
+                                            jnp.arange(bucket))
+                return caches, outs[:, 0]  # next-token after each prefix
+
+            fn = self._prefill_fns[bucket] = jax.jit(prefill)
+            self._stats["prefill_compiles"] += 1
+        return fn
+
+    def _get_insert_fn(self):
+        if self._insert_fn is None:
+            from jax import lax
+
+            def insert(pool, piece, slot):
+                # overwrite slot's WHOLE cache slice: whatever the lane
+                # held before (a released sequence, garbage writes from
+                # its idle period) is gone
+                return jax.tree.map(
+                    lambda g, p: lax.dynamic_update_slice(
+                        g, p.astype(g.dtype),
+                        (slot,) + (jnp.int32(0),) * (g.ndim - 1)),
+                    pool, piece)
+
+            self._insert_fn = jax.jit(
+                insert, donate_argnums=(0,) if self._donate else ())
+        return self._insert_fn
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def start(self) -> "InferenceEngine":
+        assert self._thread is None, "engine already started"
+        self._stop_evt.clear()
+        self._accepting = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="ff-serve-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop the loop.  ``drain=True`` finishes queued + running
+        requests first; ``drain=False`` cancels everything outstanding
+        at the next token boundary."""
+        self._accepting = False
+        self._drain = drain
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "InferenceEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
+               priority: int = 0, timeout_s: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               request_id: Optional[str] = None) -> InferenceRequest:
+        """Enqueue one prompt; returns the request handle (a future).
+        Validation errors raise here, synchronously."""
+        cfg = self.config
+        n = cfg.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if n > cfg.max_new_tokens:
+            raise ValueError(f"max_new_tokens {n} exceeds the engine cap "
+                             f"{cfg.max_new_tokens} (FF_SERVE_MAX_NEW_TOKENS)")
+        req = InferenceRequest(
+            prompt, n, priority=priority, eos_id=eos_id,
+            request_id=request_id,
+            timeout_s=cfg.queue_timeout_s if timeout_s is None
+            else timeout_s)
+        if req.timeout_s == 0:
+            req.timeout_s = None              # 0: wait forever
+        plen = int(req.prompt.size)
+        if cfg.bucket_for(plen) is None:
+            raise ValueError(
+                f"prompt length {plen} exceeds the largest prefill bucket "
+                f"{cfg.resolved_buckets()[-1]} (FF_SERVE_BUCKETS)")
+        if plen + n > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({n}) = {plen + n} "
+                f"exceeds max_seq {cfg.max_seq} (FF_SERVE_MAX_SEQ)")
+        if not self._accepting:
+            raise ServeError("engine is not accepting requests "
+                             "(not started, or stopping)")
+        self._stats["submitted"] += 1
+        self._queue.put(req)
+        return req
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = None, **kw) -> np.ndarray:
+        """Synchronous convenience: submit + result."""
+        return self.submit(prompt, max_new_tokens, **kw).result(timeout)
+
+    @property
+    def num_active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def num_queued(self) -> int:
+        return len(self._queue)
+
+    def stats(self) -> Dict[str, Any]:
+        s = dict(self._stats)
+        s["active"] = self.num_active
+        s["queued"] = self.num_queued
+        s["mean_occupancy"] = (s["occupancy_sum"] / s["step_iterations"]
+                               if s["step_iterations"] else 0.0)
+        return s
+
+    # ------------------------------------------------------------------
+    # the loop (one background thread; all jax dispatch happens here)
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        cfg = self.config
+        while True:
+            now = time.perf_counter()
+            self._stats["timeouts"] += self._queue.expire(now)
+            if self._stop_evt.is_set():
+                if not self._drain:
+                    break
+                if self.num_active == 0 and len(self._queue) == 0:
+                    break
+            self._admit_ready(now)
+            if self.num_active == 0:
+                if not self._stop_evt.is_set():
+                    self._queue.wait_nonempty(cfg.poll_interval_s)
+                continue
+            self._decode_iteration()
+        n = self._queue.drain(CANCELLED, "engine stopped")
+        self._stats["cancelled"] += n
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                slot.req._resolve(CANCELLED, "engine stopped")
+                self._stats["cancelled"] += 1
+                self._slots[i] = None
+
+    def _admit_ready(self, now: float) -> None:
+        while True:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+            if free is None:
+                return
+            req = self._queue.pop_ready(now)
+            if req is None:
+                return
+            try:
+                self._admit(req, free)
+            except Exception as e:  # noqa: BLE001 — isolate per request
+                req._resolve(ERROR, f"{type(e).__name__}: {e}")
+                self._stats["failed"] += 1
+                self._emit_done(req)
+
+    def _admit(self, req: InferenceRequest, slot: int) -> None:
+        """Prefill ``req`` into ``slot``; on return the slot is live and
+        the request owns its first generated token."""
+        self._admit_seq += 1
+        req.admit_seq = self._admit_seq
+        if self._chaos is not None:
+            # serve site: trigger = 1-based admission count; a raised
+            # fault fails THIS request only (caught in _admit_ready)
+            self._chaos.fire("serve", model=self.model)
+        req.t_admit = time.perf_counter()
+        req.status = RUNNING
+        plen = int(req.prompt.size)
+        bucket = self.config.bucket_for(plen)
+        fn = self._get_prefill_fn(bucket)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = req.prompt
+        t0 = time.perf_counter()
+        params = self.model._decode_params()
+        piece, nexts = fn(params, self.model._stats, jnp.asarray(padded))
+        first_tok = int(np.asarray(nexts)[plen - 1])
+        if self._caches is None:
+            self._caches = self.model.init_decode_caches(
+                self.config.max_batch, self.config.max_seq)
+        self._caches = self._get_insert_fn()(
+            self._caches, piece, jnp.int32(slot))
+        t1 = time.perf_counter()
+
+        req.tokens.append(first_tok)
+        req.t_first = t1
+        self._stats["admitted"] += 1
+        log = self._telemetry
+        if log is not None:
+            log.span_at("serve_queue_wait", req.t_submit,
+                        req.t_admit - req.t_submit,
+                        request_id=req.request_id, priority=req.priority)
+            log.span_at("serve_prefill", t0, t1 - t0,
+                        request_id=req.request_id, prompt_len=plen,
+                        bucket=bucket, slot=slot)
+        if req.max_new_tokens == 1 or first_tok == req.eos_id:
+            self._finish(req, slot=None, t_done=t1)
+            return
+        self._slots[slot] = _Slot(req, plen, t_first=t1)
+        self._toks[slot] = first_tok
+        self._pos[slot] = plen
+        self._stats["max_active"] = max(self._stats["max_active"],
+                                        self.num_active)
+
+    def _decode_iteration(self) -> None:
+        """One token boundary: advance every slot one position.  Idle
+        lanes compute too (static shapes) — their writes land in slots
+        the next admission overwrites wholesale."""
+        params = self.model._decode_params()
+        try:
+            self._caches, nxt = self._get_step_fn()(
+                params, self.model._stats, self._caches,
+                jnp.asarray(self._toks), jnp.asarray(self._pos))
+            nxt = np.asarray(nxt)
+        except Exception as e:  # noqa: BLE001 — a step fault kills the
+            # BATCH's requests but never the loop: resolve them all and
+            # keep serving (fresh admissions re-prefill fresh caches)
+            msg = f"decode step failed: {type(e).__name__}: {e}"
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    slot.req._resolve(ERROR, msg)
+                    self._stats["failed"] += 1
+                    self._emit_done(slot.req)
+                    self._slots[i] = None
+            return
+        t_now = time.perf_counter()
+        active = self.num_active
+        self._stats["step_iterations"] += 1
+        self._stats["occupancy_sum"] += active
+        if self._telemetry is not None:
+            self._telemetry.gauge("serve_batch_occupancy", active)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tok = int(nxt[i])
+            slot.req.tokens.append(tok)
+            slot.pos += 1
+            self._pos[i] = slot.pos
+            self._toks[i] = tok
+            if (len(slot.req.tokens) >= slot.req.max_new_tokens
+                    or tok == slot.req.eos_id):
+                self._finish(slot.req, slot=i, t_done=t_now)
+
+    def _finish(self, req: InferenceRequest, slot: Optional[int],
+                t_done: float) -> None:
+        if slot is not None:
+            self._slots[slot] = None
+            self._toks[slot] = 0
+            self._pos[slot] = 0
+        req.t_done = t_done
+        req._resolve(DONE)
+        self._stats["completed"] += 1
+        self._stats["tokens_out"] += len(req.tokens)
+        self._emit_done(req)
+
+    def _emit_done(self, req: InferenceRequest) -> None:
+        log = self._telemetry
+        if log is None:
+            return
+        if req.t_first is not None and req.t_done is not None:
+            log.span_at("serve_decode", req.t_first,
+                        req.t_done - req.t_first,
+                        request_id=req.request_id, tokens=len(req.tokens))
+        attrs = dict(request_id=req.request_id, status=req.status,
+                     prompt_len=int(req.prompt.size),
+                     new_tokens=len(req.tokens))
+        for k in ("queue_wait_s", "ttft_s", "tpot_s"):
+            v = getattr(req, k)
+            if v is not None:
+                attrs[k] = round(v, 6)
+        log.event("serve_request_done", **attrs)
+        if req.status == DONE:
+            log.counter("serve_requests", 1)
+            log.counter("serve_tokens", len(req.tokens))
+        else:
+            log.counter("serve_failed", 1, status=req.status)
+        log.flush()

@@ -1,0 +1,194 @@
+"""Request queue for the continuous-batching engine.
+
+STDLIB-ONLY: the HTTP front end and tests manipulate requests without
+touching jax.  An ``InferenceRequest`` doubles as the caller's future —
+``result()`` blocks until the engine (or an expiry sweep) resolves it.
+
+Admission order is (priority desc, arrival asc): a higher ``priority``
+request overtakes earlier lower-priority ones at the next token
+boundary, but never preempts already-running slots.  ``timeout_s``
+bounds QUEUE WAIT — a request not admitted in time fails with status
+``"timeout"`` instead of rotting behind a long backlog (the client has
+usually given up; prefilling it anyway would waste a slot).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+# terminal statuses set exactly once, under the queue/engine lock
+QUEUED, RUNNING, DONE, ERROR, TIMEOUT, CANCELLED = (
+    "queued", "running", "done", "error", "timeout", "cancelled")
+
+
+class ServeError(RuntimeError):
+    """The engine failed this request (prefill/decode error, shutdown)."""
+
+
+class ServeTimeout(TimeoutError):
+    """The request expired waiting for admission (``timeout_s``)."""
+
+
+_req_ids = itertools.count(1)
+
+
+class InferenceRequest:
+    """One generation request + its result future.
+
+    Filled in by the engine: ``tokens`` (the greedy continuation),
+    ``status``, and the latency decomposition (``t_submit`` ->
+    ``t_admit`` -> ``t_first`` -> ``t_done``, all ``time.perf_counter``
+    readings) that serve_report folds into queue-wait/TTFT/TPOT.
+    """
+
+    def __init__(self, prompt, max_new_tokens: int, *, priority: int = 0,
+                 timeout_s: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 request_id: Optional[str] = None):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.priority = int(priority)
+        self.timeout_s = None if timeout_s is None else float(timeout_s)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.request_id = request_id or f"req-{next(_req_ids)}"
+
+        self.status = QUEUED
+        self.tokens: List[int] = []
+        self.error: Optional[str] = None
+        self.t_submit: Optional[float] = None
+        self.t_admit: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.admit_seq: Optional[int] = None  # engine admission order
+        self._event = threading.Event()
+
+    # -- metrics (valid once resolved) ----------------------------------
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_submit is None or self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Submit -> first generated token available."""
+        if self.t_submit is None or self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean time per output token AFTER the first."""
+        if self.t_first is None or self.t_done is None \
+                or len(self.tokens) < 2:
+            return None
+        return (self.t_done - self.t_first) / (len(self.tokens) - 1)
+
+    # -- future protocol ------------------------------------------------
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _resolve(self, status: str, error: Optional[str] = None) -> None:
+        self.status = status
+        self.error = error
+        if self.t_done is None:
+            self.t_done = time.perf_counter()
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until resolved; the greedy continuation as (N,) int32.
+        Raises ServeTimeout (queue-wait expiry) or ServeError (engine
+        failure / shutdown)."""
+        if not self._event.wait(timeout):
+            raise ServeTimeout(
+                f"{self.request_id}: no result after {timeout}s")
+        if self.status == TIMEOUT:
+            raise ServeTimeout(
+                f"{self.request_id}: expired after {self.timeout_s}s "
+                f"in queue")
+        if self.status != DONE:
+            raise ServeError(f"{self.request_id}: {self.status}"
+                             f"{': ' + self.error if self.error else ''}")
+        return np.asarray(self.tokens, np.int32)
+
+
+class RequestQueue:
+    """Thread-safe admission queue: (priority desc, arrival asc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._heap: List = []          # (-priority, seq, req)
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def put(self, req: InferenceRequest) -> None:
+        req.t_submit = time.perf_counter()
+        with self._nonempty:
+            heapq.heappush(self._heap, (-req.priority, next(self._seq), req))
+            self._nonempty.notify_all()
+
+    def pop_ready(self, now: float) -> Optional[InferenceRequest]:
+        """Highest-priority live request, resolving any expired ones
+        encountered on the way (their callers unblock with TIMEOUT)."""
+        with self._lock:
+            while self._heap:
+                _, _, req = heapq.heappop(self._heap)
+                if self._expired(req, now):
+                    req._resolve(TIMEOUT)
+                    continue
+                return req
+        return None
+
+    def expire(self, now: float) -> int:
+        """Resolve every expired queued request (runs at each token
+        boundary so a backlogged request times out even while the
+        batch is full and nothing is being popped)."""
+        n = 0
+        with self._lock:
+            live = []
+            for entry in self._heap:
+                if self._expired(entry[2], now):
+                    entry[2]._resolve(TIMEOUT)
+                    n += 1
+                else:
+                    live.append(entry)
+            if n:
+                heapq.heapify(live)
+                self._heap = live
+        return n
+
+    def drain(self, status: str = CANCELLED,
+              error: Optional[str] = None) -> int:
+        """Resolve everything still queued (engine shutdown)."""
+        with self._lock:
+            n = len(self._heap)
+            for _, _, req in self._heap:
+                req._resolve(status, error)
+            self._heap = []
+        return n
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        with self._nonempty:
+            if self._heap:
+                return True
+            return self._nonempty.wait(timeout)
+
+    @staticmethod
+    def _expired(req: InferenceRequest, now: float) -> bool:
+        return (req.timeout_s is not None and req.t_submit is not None
+                and now - req.t_submit > req.timeout_s)

@@ -13,6 +13,7 @@ Spec grammar (``FF_CHAOS`` environment variable)::
     FF_CHAOS   = entry (";" entry)*
     entry      = site ":" trigger "=" fault [":" arg]
     site       = "step" | "data" | "ckpt_save" | "ckpt_restore" | "sync"
+               | "serve"
     trigger    = INT          exact trigger (fires once, then is spent)
                | "p" FLOAT    per-call probability (seeded, repeatable)
     fault      = "nan_loss"   poison the staged batch's float leaves with
@@ -34,6 +35,13 @@ For every other site it is the 1-based count of calls to that site's
 choke point *in this process*; checkpoint retry attempts each count,
 so ``ckpt_save:1=io_error`` fails the first attempt and lets the retry
 succeed.
+
+The ``serve`` site fires at the serving engine's per-request ADMISSION
+choke point (trigger = 1-based admission count), before the prefill —
+so ``serve:2=error`` fails exactly the second admitted request, which
+must NOT kill the batch loop or any other request (the engine's
+per-request error isolation, tests/test_serving.py); ``serve:3=hang:2``
+wedges the loop thread for 2s, stalling every in-flight request.
 
 Examples::
 
@@ -59,7 +67,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-SITES = ("step", "data", "ckpt_save", "ckpt_restore", "sync")
+SITES = ("step", "data", "ckpt_save", "ckpt_restore", "sync", "serve")
 FAULTS = ("nan_loss", "hang", "io_error", "sigterm", "sigint", "error")
 
 

@@ -140,6 +140,30 @@ def _resilience():
     return ", ".join(bits)
 
 
+def _serving():
+    # Effective FF_SERVE_* env as serving/config.py will see it (a bad
+    # value raises here, not at server startup), plus a bind probe of
+    # the configured HTTP endpoint — a port already taken or a host
+    # that doesn't resolve otherwise fails only when traffic arrives.
+    import socket
+
+    from ..serving.config import ServeConfig
+
+    cfg = ServeConfig.from_env()  # ValueError on a typo'd env var
+    bits = [cfg.describe()]
+    probe_port = cfg.port if os.environ.get("FF_SERVE_PORT") else 0
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((cfg.host, probe_port))
+        bound = s.getsockname()[1]
+        bits.append(f"bind {cfg.host}:{bound} ok"
+                    + ("" if probe_port else " (ephemeral probe)"))
+    finally:
+        s.close()
+    return ", ".join(bits)
+
+
 def _cpu_train():
     import jax
 
@@ -186,6 +210,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              ("optional deps", _optional_deps, False),
              ("observability", _observability, False),
              ("resilience", _resilience, False),
+             ("serving", _serving, False),
              ("cpu training", _cpu_train, True)]
 
     # print each line as its check completes — the slow checks (90s

@@ -1,0 +1,254 @@
+"""Seeded serving load generator -> ``BENCH_SERVE.json``.
+
+Self-contained benchmark of the continuous-batching stack: builds a
+tiny decoder transformer (optionally trains it a few steps so the
+continuations are non-degenerate), starts an ``InferenceEngine`` plus
+the stdlib HTTP front end, then drives it with a SEEDED request mix —
+so every run, and every future PR's run, replays the identical traffic
+and the emitted numbers form a serving perf trajectory next to
+``BENCH_r*.json``.
+
+Modes:
+  closed (default)  ``--concurrency`` workers each keep exactly one
+                    request in flight (classic closed loop: measures
+                    capacity at a fixed multiprogramming level)
+  open              requests arrive on a seeded Poisson clock at
+                    ``--rate`` req/s regardless of completions (measures
+                    latency under offered load; backlog grows if the
+                    engine can't keep up)
+
+``--check-generate`` re-runs every prompt through one-shot
+``FFModel.generate()`` and counts greedy matches — the continuous batch
+must be bitwise-transparent (docs/serving.md).
+
+Usage:
+    python -m flexflow_tpu.tools.loadgen --requests 8 --concurrency 4 \
+        --seed 0 --train-iters 20 --check-generate --out BENCH_SERVE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+from typing import List, Optional
+
+
+def _build_model(vocab: int, max_seq: int, train_iters: int, seed: int):
+    import numpy as np
+
+    import flexflow_tpu as ff
+    from flexflow_tpu.models.transformer import build_transformer
+
+    cfg = ff.FFConfig(batch_size=8)
+    model = ff.FFModel(cfg)
+    tok, pos, _ = build_transformer(model, cfg.batch_size,
+                                    seq_length=max_seq, num_layers=2,
+                                    embed_dim=32, num_heads=2,
+                                    vocab_size=vocab)
+    model.compile(ff.AdamOptimizer(model, alpha=3e-3),
+                  ff.LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [ff.MetricsType.ACCURACY])
+    model.init_layers(seed=seed)
+    rng = np.random.default_rng(seed)
+    for _ in range(train_iters):
+        # the +1 (mod vocab) pattern of examples/transformer_generate.py
+        start = rng.integers(0, vocab, size=(cfg.batch_size, 1))
+        toks = ((start + np.arange(max_seq)) % vocab).astype(np.int32)
+        posa = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                               toks.shape).copy()
+        labels = ((toks + 1) % vocab).astype(np.int32)
+        model.set_batch({tok: toks, pos: posa}, labels)
+        model.train_iteration()
+    model.sync()
+    return model
+
+
+def _make_requests(n: int, seed: int, vocab: int, prompt_lens: str,
+                   new_tokens: str):
+    import numpy as np
+
+    p_lo, p_hi = (int(x) for x in prompt_lens.split(":"))
+    n_lo, n_hi = (int(x) for x in new_tokens.split(":"))
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n):
+        plen = int(rng.integers(p_lo, p_hi + 1))
+        reqs.append((rng.integers(0, vocab, size=plen).astype(np.int32),
+                     int(rng.integers(n_lo, n_hi + 1))))
+    return reqs
+
+
+def _post(url: str, prompt, n: int, timeout: float):
+    body = json.dumps({"prompt": [int(t) for t in prompt],
+                       "max_new_tokens": n}).encode()
+    req = urllib.request.Request(f"{url}/generate", data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _pcts(vals: List[float]) -> dict:
+    from .trace_report import percentile
+
+    vals = sorted(vals)
+    if not vals:
+        return {}
+    return {"p50": round(percentile(vals, 50), 6),
+            "p95": round(percentile(vals, 95), 6),
+            "p99": round(percentile(vals, 99), 6),
+            "mean": round(sum(vals) / len(vals), 6)}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop workers (closed mode)")
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--rate", type=float, default=8.0,
+                   help="open-loop arrival rate, req/s")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--vocab", type=int, default=32)
+    p.add_argument("--max-seq", type=int, default=64)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--prompt-lens", default="3:12", help="lo:hi inclusive")
+    p.add_argument("--new-tokens", default="8:24", help="lo:hi inclusive")
+    p.add_argument("--train-iters", type=int, default=0,
+                   help="train the toy model this many steps first")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="per-request HTTP timeout, seconds")
+    p.add_argument("--out", default="BENCH_SERVE.json")
+    p.add_argument("--check-generate", action="store_true",
+                   help="verify each output against one-shot generate()")
+    args = p.parse_args(argv)
+
+    print(f"loadgen: building model (vocab={args.vocab}, "
+          f"max_seq={args.max_seq}, train_iters={args.train_iters})",
+          flush=True)
+    model = _build_model(args.vocab, args.max_seq, args.train_iters,
+                         args.seed)
+    reqs = _make_requests(args.requests, args.seed, args.vocab,
+                          args.prompt_lens, args.new_tokens)
+
+    from ..serving.api import ServingAPI
+    from ..serving.engine import InferenceEngine
+
+    engine = InferenceEngine(model, max_batch=args.max_batch,
+                             max_seq=args.max_seq,
+                             max_new_tokens=max(int(args.new_tokens
+                                                    .split(":")[1]), 1))
+    results: List[Optional[dict]] = [None] * len(reqs)
+    errors: List[str] = []
+    t_start = time.perf_counter()
+    with engine, ServingAPI(engine, port=0) as api:
+        print(f"loadgen: serving on {api.url}, firing {len(reqs)} "
+              f"requests ({args.mode} loop)", flush=True)
+
+        def fire(i: int) -> None:
+            prompt, n = reqs[i]
+            try:
+                results[i] = _post(api.url, prompt, n, args.timeout)
+            except Exception as e:  # noqa: BLE001 — collected + reported
+                errors.append(f"request {i}: {type(e).__name__}: {e}")
+
+        threads: List[threading.Thread] = []
+        if args.mode == "closed":
+            nxt = {"i": 0}
+            lock = threading.Lock()
+
+            def worker() -> None:
+                while True:
+                    with lock:
+                        i = nxt["i"]
+                        if i >= len(reqs):
+                            return
+                        nxt["i"] = i + 1
+                    fire(i)
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(max(1, args.concurrency))]
+            for t in threads:
+                t.start()
+        else:
+            import random
+
+            rng = random.Random(args.seed)
+            delay = 0.0
+            for i in range(len(reqs)):
+                delay += rng.expovariate(args.rate)
+                t = threading.Timer(delay, fire, args=(i,))
+                t.daemon = True
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join(args.timeout + 60)
+        # wait for the last open-loop responses
+        deadline = time.perf_counter() + args.timeout
+        while args.mode == "open" and time.perf_counter() < deadline \
+                and any(r is None for r in results) \
+                and len(errors) + sum(r is not None for r in results) \
+                < len(reqs):
+            time.sleep(0.05)
+        wall = time.perf_counter() - t_start
+        stats = engine.stats()
+
+    ok = [r for r in results if r is not None]
+    bench = {
+        "bench": "serving_loadgen",
+        "mode": args.mode, "seed": args.seed,
+        "requests": args.requests,
+        "concurrency": args.concurrency if args.mode == "closed"
+        else None,
+        "rate_rps": args.rate if args.mode == "open" else None,
+        "max_batch": args.max_batch, "max_seq": args.max_seq,
+        "n_ok": len(ok), "n_fail": len(reqs) - len(ok),
+        "wall_s": round(wall, 3),
+        "ttft_s": _pcts([r["ttft_s"] for r in ok if "ttft_s" in r]),
+        "tpot_s": _pcts([r["tpot_s"] for r in ok if "tpot_s" in r]),
+        "queue_wait_s": _pcts([r["queue_wait_s"] for r in ok
+                               if "queue_wait_s" in r]),
+        "achieved_tokens_s": round(
+            sum(len(r["tokens"]) for r in ok) / wall, 2) if wall > 0
+        else 0.0,
+        "mean_batch_occupancy": round(stats["mean_occupancy"], 3),
+        "engine": {k: stats[k] for k in
+                   ("admitted", "completed", "failed", "timeouts",
+                    "prefill_compiles", "step_iterations", "max_active")},
+    }
+
+    if args.check_generate:
+        import numpy as np
+
+        matches = 0
+        for r, (prompt, n) in zip(results, reqs):
+            if r is None:
+                continue
+            want = model.generate(prompt[None], n)[0]
+            matches += bool(np.array_equal(
+                np.asarray(r["tokens"], np.int32), want))
+        bench["greedy_matches"] = matches
+        print(f"loadgen: greedy outputs match one-shot generate() for "
+              f"{matches}/{len(ok)} requests", flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(bench, f, indent=2, sort_keys=True)
+        f.write("\n")
+    for e in errors:
+        print(f"loadgen: ERROR {e}", file=sys.stderr)
+    print(f"loadgen: {len(ok)}/{len(reqs)} ok in {wall:.2f}s · "
+          f"TTFT p95 {bench['ttft_s'].get('p95', 0) * 1e3:.0f}ms · "
+          f"{bench['achieved_tokens_s']:.1f} tok/s · "
+          f"occupancy {bench['mean_batch_occupancy']:.2f} -> {args.out}",
+          flush=True)
+    failed = (len(ok) != len(reqs)
+              or (args.check_generate
+                  and bench["greedy_matches"] != len(ok)))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

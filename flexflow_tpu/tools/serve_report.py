@@ -1,0 +1,187 @@
+"""Fold a serving telemetry trace into a markdown latency report.
+
+Reads the records the serving engine emits (``serve_queue_wait`` /
+``serve_prefill`` / ``serve_decode`` spans, ``serve_request_done``
+events, the per-token-boundary ``serve_batch_occupancy`` gauge) and
+renders the standard serving lens: request outcomes, queue-wait / TTFT /
+TPOT percentiles, achieved tokens/s, and batch occupancy over time —
+the metric that says whether continuous batching actually batched.
+
+STDLIB-ONLY, like every report CLI here: a trace from a serving TPU
+must be foldable on any laptop.
+
+Usage:
+    python -m flexflow_tpu.tools.serve_report ff_trace.jsonl
+    python -m flexflow_tpu.tools.serve_report ff_trace.jsonl -o report.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional
+
+from .trace_report import parse_trace, percentile
+
+_LAT_ROWS = (  # (label, key into serve_request_done attrs)
+    ("queue wait", "queue_wait_s"),
+    ("TTFT", "ttft_s"),
+    ("TPOT", "tpot_s"),
+    ("end-to-end", "e2e_s"),
+)
+
+
+def _lat_line(label: str, vals: List[float]) -> str:
+    vals = sorted(vals)
+    mean = sum(vals) / len(vals)
+    cells = [f"{percentile(vals, q) * 1e3:.1f}" for q in (50, 95, 99)]
+    return (f"| {label} | {len(vals)} | " + " | ".join(cells)
+            + f" | {mean * 1e3:.1f} | {vals[-1] * 1e3:.1f} |")
+
+
+def render_report(records: List[Dict[str, Any]],
+                  occupancy_windows: int = 12) -> str:
+    meta: Dict[str, Any] = {}
+    done_events: List[Dict[str, Any]] = []
+    occ: List[tuple] = []          # (ts, active)
+    admits: List[float] = []       # serve_prefill span start times
+    ends: List[float] = []         # serve_decode span end times
+    counters: Dict[str, float] = {}
+    for r in records:
+        t, name = r.get("t"), r.get("name")
+        if t == "meta":
+            meta = r
+        elif t == "event" and name == "serve_request_done":
+            done_events.append(r)
+        elif t == "gauge" and name == "serve_batch_occupancy":
+            occ.append((float(r.get("ts", 0.0)), float(r.get("v", 0.0))))
+        elif t == "span" and name == "serve_prefill":
+            admits.append(float(r.get("ts", 0.0)))
+        elif t == "span" and name == "serve_decode":
+            ends.append(float(r.get("ts", 0.0)) + float(r.get("dur", 0.0)))
+        elif t == "counter" and name and name.startswith("serve_"):
+            counters[name] = r.get("total", r.get("v", 0.0))
+
+    lines = ["# flexflow_tpu serving report", ""]
+    if meta:
+        lines += [f"run `{meta.get('run_id', '?')}` · pid "
+                  f"{meta.get('pid', '?')} · {len(records)} records", ""]
+    if not done_events and not occ:
+        lines += ["_(no serving records in trace — was the engine run "
+                  "with telemetry enabled?)_", ""]
+        return "\n".join(lines)
+
+    # ---- requests -----------------------------------------------------
+    by_status: Dict[str, int] = {}
+    prompt_toks = gen_toks = 0
+    for e in done_events:
+        a = e.get("attrs", {})
+        by_status[a.get("status", "?")] = \
+            by_status.get(a.get("status", "?"), 0) + 1
+        prompt_toks += int(a.get("prompt_len", 0))
+        if a.get("status") == "done":
+            gen_toks += int(a.get("new_tokens", 0))
+    lines += ["## Requests", "",
+              "| status | count |", "|---|---|"]
+    for status in sorted(by_status):
+        lines.append(f"| {status} | {by_status[status]} |")
+    lines += ["",
+              f"- prompt tokens in: {prompt_toks} · tokens generated "
+              f"(completed): {gen_toks}", ""]
+
+    # ---- latency ------------------------------------------------------
+    series: Dict[str, List[float]] = {k: [] for _, k in _LAT_ROWS}
+    for e in done_events:
+        a = e.get("attrs", {})
+        for _, key in _LAT_ROWS:
+            if key == "e2e_s":
+                continue
+            if a.get(key) is not None:
+                series[key].append(float(a[key]))
+        if a.get("ttft_s") is not None:
+            tp = float(a.get("tpot_s") or 0.0)
+            series["e2e_s"].append(
+                float(a["ttft_s"]) + tp * max(0, int(a.get("new_tokens", 1)) - 1))
+    rows = [(lbl, series[key]) for lbl, key in _LAT_ROWS if series[key]]
+    if rows:
+        lines += ["## Latency (ms)", "",
+                  "| metric | n | p50 | p95 | p99 | mean | max |",
+                  "|---|---|---|---|---|---|---|"]
+        lines += [_lat_line(lbl, vals) for lbl, vals in rows]
+        lines.append("")
+
+    # ---- throughput ---------------------------------------------------
+    if admits and ends:
+        wall = max(ends) - min(admits)
+        lines += ["## Throughput", ""]
+        if wall > 0 and gen_toks:
+            lines.append(f"- {gen_toks} tokens in {wall:.3f}s serving "
+                         f"window -> {gen_toks / wall:.1f} tokens/s")
+        n_done = by_status.get("done", 0)
+        if wall > 0 and n_done:
+            lines.append(f"- {n_done / wall:.2f} completed requests/s")
+        for name in sorted(counters):
+            lines.append(f"- counter {name}: {counters[name]:g}")
+        lines.append("")
+
+    # ---- batch occupancy ----------------------------------------------
+    if occ:
+        vals = [v for _, v in occ]
+        mean = sum(vals) / len(vals)
+        lines += ["## Batch occupancy", "",
+                  f"- mean {mean:.2f} active slots over {len(occ)} token "
+                  f"boundaries (max {max(vals):g})", ""]
+        t0, t1 = occ[0][0], occ[-1][0]
+        if t1 > t0 and len(occ) > 1:
+            width = (t1 - t0) / occupancy_windows
+            lines += ["| window | mean active | |", "|---|---|---|"]
+            for w in range(occupancy_windows):
+                lo = t0 + w * width
+                hi = lo + width if w < occupancy_windows - 1 else t1 + 1e-9
+                wv = [v for ts, v in occ if lo <= ts < hi]
+                if not wv:
+                    continue
+                m = sum(wv) / len(wv)
+                bar = "#" * max(1, round(m * 2))
+                lines.append(f"| {lo:.2f}-{hi:.2f}s | {m:.2f} | `{bar}` |")
+            lines.append("")
+
+    # ---- failures -----------------------------------------------------
+    bad = [e for e in done_events
+           if e.get("attrs", {}).get("status") != "done"]
+    if bad:
+        lines += ["## Failures", ""]
+        for e in bad:
+            a = e.get("attrs", {})
+            lines.append(f"- `{a.get('request_id', '?')}`: "
+                         f"{a.get('status', '?')} "
+                         f"(t={float(e.get('ts', 0.0)):.2f}s)")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> str:
+    p = argparse.ArgumentParser(
+        description="Fold a flexflow_tpu serving trace into a markdown "
+                    "latency/occupancy report.")
+    p.add_argument("trace", help="path to the JSONL trace "
+                                 "(FF_TELEMETRY_FILE / ff_trace.jsonl)")
+    p.add_argument("-o", "--out", default=None,
+                   help="write report to this file instead of stdout")
+    p.add_argument("--windows", type=int, default=12,
+                   help="occupancy timeline buckets (default 12)")
+    args = p.parse_args(argv)
+
+    records = parse_trace(args.trace)
+    report = render_report(records, occupancy_windows=args.windows)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"{len(records)} records -> {args.out}")
+    else:
+        sys.stdout.write(report)
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,31 @@ python -m flexflow_tpu.tools.search_report \
   || { echo "search smoke: strategy diff failed"; exit 1; }
 echo "search smoke: OK ($(wc -l < "$STRACE") trace records)"
 
+# Serving smoke: train the toy transformer, serve 8 concurrent HTTP
+# requests through the continuous-batching engine, verify every greedy
+# output bitwise against one-shot generate(), and fold the serving
+# trace into a latency/occupancy report (docs/serving.md).
+SERVE_TRACE="$SMOKE_DIR/serve.jsonl"
+FF_TELEMETRY=1 FF_TELEMETRY_FILE="$SERVE_TRACE" \
+  python -m flexflow_tpu.tools.loadgen --requests 8 --concurrency 4 \
+    --seed 0 --train-iters 20 --check-generate \
+    --out "$SMOKE_DIR/BENCH_SERVE.json" \
+  || { echo "serving smoke: loadgen failed (request error or greedy mismatch)"; exit 1; }
+python -m flexflow_tpu.tools.serve_report "$SERVE_TRACE" \
+  | grep -q "## Latency" \
+  || { echo "serving smoke: serve_report missing latency section"; exit 1; }
+python - "$SMOKE_DIR/BENCH_SERVE.json" <<'EOF' \
+  || { echo "serving smoke: BENCH_SERVE.json acceptance failed"; exit 1; }
+import json, sys
+b = json.load(open(sys.argv[1]))
+assert b["n_ok"] == 8 and b["greedy_matches"] == 8, b
+assert b["mean_batch_occupancy"] > 1.5, b["mean_batch_occupancy"]
+EOF
+echo "serving smoke: OK ($(python -c "
+import json, sys
+b = json.load(open('$SMOKE_DIR/BENCH_SERVE.json'))
+print(f\"{b['achieved_tokens_s']} tok/s, occupancy {b['mean_batch_occupancy']}\")"))"
+
 # Chaos smoke: one seeded FF_CHAOS run injects a NaN step, a mid-epoch
 # SIGTERM, and a failing checkpoint write; the resumed run must finish
 # bitwise-equal to an uninterrupted baseline and the trace must narrate

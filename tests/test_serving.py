@@ -1,0 +1,324 @@
+"""Continuous-batching serving subsystem (flexflow_tpu/serving/).
+
+The load-bearing claim: admitting requests mid-flight into a slot-based
+kv pool is TRANSPARENT — every request's greedy output is bitwise the
+tokens a standalone ``FFModel.generate()`` call produces for the same
+prompt, while device shapes stay static (one jitted step fn, one
+prefill fn per prompt bucket — asserted via the jit-cache counters).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.transformer import build_transformer
+from flexflow_tpu.observability import events
+from flexflow_tpu.serving import (InferenceRequest, RequestQueue,
+                                  ServeConfig, ServeError, ServeTimeout)
+from flexflow_tpu.serving.engine import InferenceEngine
+from flexflow_tpu.tools import serve_report
+
+V = 32          # vocab
+MAX_SEQ = 64
+
+
+def _make_model(seed=3):
+    cfg = ff.FFConfig(batch_size=4)
+    m = ff.FFModel(cfg)
+    build_transformer(m, 4, seq_length=MAX_SEQ, num_layers=1,
+                      embed_dim=16, num_heads=2, vocab_size=V)
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=seed)
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    # untrained is fine: greedy equivalence needs determinism, not
+    # accuracy — and skips a training loop per module
+    return _make_model()
+
+
+def _prompts(n, seed=0, lo=3, hi=11):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, V, size=int(rng.integers(lo, hi + 1)))
+            .astype(np.int32) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# config / queue units
+# ---------------------------------------------------------------------------
+
+def test_serve_config_env_and_buckets(monkeypatch):
+    monkeypatch.setenv("FF_SERVE_MAX_BATCH", "3")
+    monkeypatch.setenv("FF_SERVE_MAX_SEQ", "48")
+    monkeypatch.setenv("FF_SERVE_BUCKETS", "4,16")
+    monkeypatch.setenv("FF_SERVE_QUEUE_TIMEOUT", "2.5")
+    cfg = ServeConfig.from_env()
+    assert (cfg.max_batch, cfg.max_seq) == (3, 48)
+    assert cfg.resolved_buckets() == (4, 16)
+    assert cfg.bucket_for(4) == 4 and cfg.bucket_for(5) == 16
+    assert cfg.bucket_for(17) is None
+    assert cfg.queue_timeout_s == 2.5
+    # explicit override beats env
+    assert ServeConfig.from_env(max_batch=9).max_batch == 9
+    # default ladder: powers of two strictly below max_seq
+    assert ServeConfig(max_seq=64).resolved_buckets() == (8, 16, 32)
+
+
+def test_serve_config_rejects_bad_env(monkeypatch):
+    monkeypatch.setenv("FF_SERVE_MAX_BATCH", "zero")
+    with pytest.raises(ValueError, match="FF_SERVE_MAX_BATCH"):
+        ServeConfig.from_env()
+    monkeypatch.delenv("FF_SERVE_MAX_BATCH")
+    monkeypatch.setenv("FF_SERVE_BUCKETS", "16,8")
+    with pytest.raises(ValueError, match="ascending"):
+        ServeConfig.from_env()
+    monkeypatch.delenv("FF_SERVE_BUCKETS")
+    with pytest.raises(ValueError, match="no room"):
+        ServeConfig(max_seq=16, buckets=(16,))
+
+
+def test_request_queue_priority_and_expiry():
+    q = RequestQueue()
+    a = InferenceRequest([1], 4, priority=0)
+    b = InferenceRequest([1], 4, priority=5)
+    c = InferenceRequest([1], 4, priority=1, timeout_s=0.0001)
+    for r in (a, b, c):
+        q.put(r)
+    time.sleep(0.01)
+    now = time.perf_counter()
+    assert q.pop_ready(now) is b           # highest priority first
+    assert q.expire(now) == 1              # c expired while queued
+    assert c.status == "timeout"
+    with pytest.raises(ServeTimeout):
+        c.result(0)
+    assert q.pop_ready(now) is a
+    assert q.pop_ready(now) is None
+
+
+# ---------------------------------------------------------------------------
+# engine core
+# ---------------------------------------------------------------------------
+
+def test_greedy_equivalence_and_occupancy(model):
+    """Acceptance: 8 staggered mixed-length requests, every output
+    bitwise-equal to a one-shot generate() of the same prompt, and the
+    continuous batch actually batched (mean occupancy > 1.5)."""
+    prompts = _prompts(8, seed=1)
+    news = [6, 16, 4, 12, 9, 15, 8, 10]
+    eng = InferenceEngine(model, max_batch=4, max_seq=MAX_SEQ,
+                          max_new_tokens=32)
+    with eng:
+        handles = []
+        for p, n in zip(prompts, news):
+            handles.append(eng.submit(p, n))
+            time.sleep(0.002)              # staggered arrivals
+        outs = [h.result(180) for h in handles]
+    for p, n, out in zip(prompts, news, outs):
+        want = model.generate(p[None], n)[0]
+        assert np.array_equal(out, want), \
+            f"prompt {p.tolist()}: {out.tolist()} != {want.tolist()}"
+    st = eng.stats()
+    assert st["completed"] == 8
+    assert st["mean_occupancy"] > 1.5, st
+
+
+def test_slot_reuse_after_completion(model):
+    """6 requests through 2 slots: every slot is recycled mid-flight."""
+    eng = InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                          max_new_tokens=16)
+    with eng:
+        hs = [eng.submit(p, 5) for p in _prompts(6, seed=2)]
+        for h in hs:
+            h.result(120)
+    st = eng.stats()
+    assert st["admitted"] == 6 and st["completed"] == 6
+    assert st["max_active"] <= 2           # never more slots than pool
+    assert all(s is None for s in eng._slots)
+
+
+def test_bucketed_prefill_no_retrace(model):
+    """Prompt lengths 3,4,5,7,8 pad into buckets {4, 8}: exactly two
+    prefill compilations, and the shared step fn compiles once."""
+    eng = InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                          buckets=(4, 8), max_new_tokens=8)
+    rng = np.random.default_rng(5)
+    with eng:
+        hs = [eng.submit(rng.integers(0, V, size=n).astype(np.int32), 3)
+              for n in (3, 4, 5, 7, 8)]
+        for h in hs:
+            h.result(120)
+    assert sorted(eng._prefill_fns) == [4, 8]
+    assert eng.stats()["prefill_compiles"] == 2
+
+
+def test_queue_timeout_and_priority_order(model):
+    eng = InferenceEngine(model, max_batch=1, max_seq=MAX_SEQ,
+                          max_new_tokens=32)
+    prompts = _prompts(4, seed=7)
+    # submitted before start: admission order is purely (priority desc,
+    # arrival asc) — max_batch=1 serializes it
+    slow = eng.submit(prompts[0], 24, priority=10)
+    low = eng.submit(prompts[1], 3, priority=0)
+    high = eng.submit(prompts[2], 3, priority=5)
+    doomed = eng.submit(prompts[3], 3, timeout_s=0.001)
+    with eng:
+        slow.result(180)
+        low.result(120)
+        high.result(120)
+        with pytest.raises(ServeTimeout):
+            doomed.result(120)
+    assert doomed.status == "timeout"
+    assert slow.admit_seq < high.admit_seq < low.admit_seq
+    assert eng.stats()["timeouts"] == 1
+
+
+def test_eos_stops_early(model):
+    prompt = _prompts(1, seed=11)[0]
+    want = model.generate(prompt[None], 8)[0]
+    eos = int(want[2])
+    stop = int(np.argmax(want == eos))     # first occurrence, inclusive
+    eng = InferenceEngine(model, max_batch=1, max_seq=MAX_SEQ,
+                          max_new_tokens=8)
+    with eng:
+        out = eng.submit(prompt, 8, eos_id=eos).result(120)
+    assert np.array_equal(out, want[:stop + 1])
+
+
+def test_submit_validation(model):
+    eng = InferenceEngine(model, max_batch=1, max_seq=16,
+                          buckets=(8,), max_new_tokens=16)
+    with pytest.raises(ValueError, match="bucket"):
+        eng.submit(np.arange(9, dtype=np.int32), 2)
+    with pytest.raises(ValueError, match="max_seq"):
+        eng.submit(np.arange(8, dtype=np.int32), 16)
+    with pytest.raises(ValueError, match="exceeds the engine cap"):
+        eng.submit([1, 2], 17)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit([], 2)
+
+
+def test_engine_rejects_extra_graph_inputs():
+    """A third graph input (seq2seq-style) can't be fed one token at a
+    time — the engine must refuse at construction, not mis-serve."""
+    m2 = ff.FFModel(ff.FFConfig(batch_size=4))
+    toks = m2.create_tensor((4, 8), dtype="int32", nchw=False, name="toks")
+    pos = m2.create_tensor((4, 8), dtype="int32", nchw=False, name="pos")
+    seg = m2.create_tensor((4, 8), dtype="int32", nchw=False, name="seg")
+    x = m2.add(m2.embedding(toks, V, 16, aggr=ff.AggrMode.NONE, name="e1"),
+               m2.embedding(pos, 8, 16, aggr=ff.AggrMode.NONE, name="e2"),
+               name="a1")
+    x = m2.add(x, m2.embedding(seg, 4, 16, aggr=ff.AggrMode.NONE,
+                               name="e3"), name="a2")
+    m2.softmax(m2.dense(x, V, name="head"), name="sm")
+    m2.compile(ff.SGDOptimizer(lr=0.1),
+               "sparse_categorical_crossentropy", ["accuracy"])
+    m2.init_layers(seed=0)
+    with pytest.raises(ValueError, match="extra graph input"):
+        InferenceEngine(m2, max_batch=1, max_seq=8)
+
+
+def test_stop_cancels_outstanding(model):
+    eng = InferenceEngine(model, max_batch=1, max_seq=MAX_SEQ,
+                          max_new_tokens=32)
+    eng.start()
+    hs = [eng.submit(p, 24) for p in _prompts(3, seed=13)]
+    hs[0].result(180)                      # first one through
+    eng.stop(drain=False)
+    for h in hs[1:]:
+        if not h.done() or h.status != "done":
+            with pytest.raises(ServeError):
+                h.result(5)
+    with pytest.raises(ServeError, match="not accepting"):
+        eng.submit([1, 2], 2)
+
+
+# ---------------------------------------------------------------------------
+# chaos: per-request error isolation
+# ---------------------------------------------------------------------------
+
+def test_serve_chaos_error_isolated(monkeypatch):
+    """``serve:2=error``: the second ADMITTED request fails alone — the
+    loop and both neighbors are untouched (FF_CHAOS serve site)."""
+    monkeypatch.setenv("FF_CHAOS", "serve:2=error")
+    m = _make_model(seed=4)                # compile resolves the monkey
+    assert m._chaos is not None
+    eng = InferenceEngine(m, max_batch=1, max_seq=MAX_SEQ,
+                          max_new_tokens=8)
+    hs = [eng.submit(p, 4) for p in _prompts(3, seed=17)]
+    with eng:
+        out0 = hs[0].result(120)
+        with pytest.raises(ServeError, match="ChaosError"):
+            hs[1].result(120)
+        out2 = hs[2].result(120)
+    assert hs[1].status == "error"
+    assert ("serve", 2, "error") in m._chaos.fired
+    assert np.array_equal(out0, m.generate(hs[0].prompt[None], 4)[0])
+    assert np.array_equal(out2, m.generate(hs[2].prompt[None], 4)[0])
+    st = eng.stats()
+    assert st["completed"] == 2 and st["failed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end + serve_report
+# ---------------------------------------------------------------------------
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_http_roundtrip_ephemeral_port(model, tmp_path):
+    from flexflow_tpu.serving.api import ServingAPI
+
+    log = events.EventLog(str(tmp_path / "serve.jsonl"))
+    eng = InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                          max_new_tokens=16, telemetry=log)
+    prompt = _prompts(1, seed=19)[0]
+    with eng, ServingAPI(eng, port=0) as api:
+        out = _post(f"{api.url}/generate",
+                    {"prompt": [int(t) for t in prompt],
+                     "max_new_tokens": 6})
+        assert np.array_equal(np.asarray(out["tokens"], np.int32),
+                              model.generate(prompt[None], 6)[0])
+        assert out["prompt_len"] == prompt.size
+        assert out["ttft_s"] > 0
+        # health endpoint reflects live engine state
+        with urllib.request.urlopen(f"{api.url}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok" and health["completed"] >= 1
+        # malformed body / sampling knob / unknown path -> 4xx
+        for payload, code in ((
+                {"max_new_tokens": 4}, 400),           # no prompt
+                ({"prompt": [1], "temperature": 0.7}, 400)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{api.url}/generate", payload)
+            assert ei.value.code == code
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{api.url}/nope", timeout=30)
+        assert ei.value.code == 404
+    log.close()
+
+    # the trace the round-trip produced folds into a serving report
+    report = serve_report.main([str(tmp_path / "serve.jsonl"),
+                                "-o", str(tmp_path / "r.md")])
+    assert "## Latency (ms)" in report
+    assert "| queue wait |" in report and "| TTFT |" in report
+    assert "## Batch occupancy" in report
+    assert "| done | 1 |" in report
+
+
+def test_serve_report_empty_trace(tmp_path):
+    p = tmp_path / "empty.jsonl"
+    p.write_text('{"t": "meta", "run_id": "x", "pid": 1}\n')
+    assert "no serving records" in serve_report.main([str(p)])
