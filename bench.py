@@ -9,14 +9,39 @@ watchdog *thread* (not SIGALRM — Python signal handlers can't fire
 while the main thread is blocked inside a C++ device wait) enforces a
 deadline per phase and a global wall budget via ``os._exit``.
 
+Degradation ladder (round-6 redesign — degrade, don't die):
+  1. PROBE: a subprocess TPU probe via observability/chipwatch (a
+     wedged tunnel kills the child, never this process).  A caller that
+     pinned ``JAX_PLATFORMS=cpu`` or set ``FF_BENCH_FORCE_PROXY=1``
+     skips straight to rung 3.
+  2. Chip answered: the real TPU bench (preflight -> alexnet primary ->
+     extras), exactly the round-4 protocol.
+  3. No chip: a CPU proxy metric — a small AlexNet train loop, clearly
+     stamped ``"proxy": true`` with provenance and the cached last-good
+     chip number alongside — and **exit 0**.  Availability of the
+     measurement pipeline is the signal; rc=1 with value 0.0 taught us
+     nothing five rounds running.
+  4. Probe passed but in-process init then failed/fell back: the error
+     line is emitted, then the proxy runs in a fresh forced-proxy
+     subprocess (this process's backend can no longer flip to CPU).
+Every result — real, proxy, or watchdog kill — is appended to the
+perf ledger (tools/perf_ledger.py, ``PERF_LEDGER.jsonl``) with
+backend/provenance/commit fields.
+
 Output protocol:
   - stdout line 1 (immediate): primary metric, with AlexNet MFU as a
     top-level headline companion (``mfu``).
   - stdout line 2 (only if every extra phase finishes in budget): the
     SAME metric/value re-printed enriched with all extras — whichever
     line a tail-parser picks, the headline number is identical.
-  - ``BENCH_EXTRA.json`` side file: rewritten after every phase, so
-    partial extras survive any kill.
+  - on a watchdog kill after line 1, the primary is re-flushed whole on
+    a fresh line before ``os._exit`` — the LAST stdout line is always a
+    complete, parseable JSON result even when the main thread died
+    mid-print.
+  - ``BENCH_EXTRA.json`` side file (``FF_BENCH_EXTRA_PATH``): rewritten
+    after every phase, so partial extras survive any kill.
+  - proxy/kill records name the phase the PREVIOUS run stranded in,
+    read from the heartbeat file it left behind (``stranded_phase``).
 
 Primary metric (continuity with earlier rounds): AlexNet samples/s/chip
 against the 375 samples/s/chip parity bar.  Baseline derivation
@@ -36,31 +61,42 @@ import sys
 import threading
 import time
 
-sys.path.insert(0, ".")
+# the repo root by absolute path, not "." — bench must import its own
+# package no matter what cwd the driver launches it from
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 PER_CHIP_BASELINE = 375.0  # samples/s/chip parity bar (see docstring)
 PEAK_FLOPS = 197e12        # v5e bf16
 
 
+_tool_mods = {}
+
+
+def _load_tool(name):
+    """Load a stdlib-only flexflow_tpu/tools/ module by file path.
+    Importing the package would execute its __init__ (jax + the whole
+    framework) at an uncontrolled moment, outside the phase budgets and
+    the watchdog's error reporting."""
+    if name not in _tool_mods:
+        import importlib.util
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "flexflow_tpu", "tools", name + ".py")
+        spec = importlib.util.spec_from_file_location("_ff_" + name, p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _tool_mods[name] = mod
+    return _tool_mods[name]
+
+
 def _shared_bench_batch():
     # Single source with calibrate/soap_report (the agreement check
     # converts this phase's samples/s to ms/step with the SAME batch).
-    # Loaded by file path: importing flexflow_tpu.tools would execute
-    # the package __init__ (jax + the whole framework) at module load,
-    # outside the phase budgets and the watchdog's error reporting.
     # Any failure falls back to the historical 256 — a bench that runs
     # with a slightly stale constant beats one that dies before the
     # wedge-proof primary-line protocol even starts.
     try:
-        import importlib.util
-
-        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "flexflow_tpu", "tools", "report_configs.py")
-        spec = importlib.util.spec_from_file_location(
-            "_ff_report_configs", p)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        return int(mod.BENCH_SINGLE_CHIP_BATCH)
+        return int(_load_tool("report_configs").BENCH_SINGLE_CHIP_BATCH)
     except Exception:
         return 256
 
@@ -71,6 +107,10 @@ TRANSFORMER_VOCAB = 32000
 
 GLOBAL_BUDGET = 1080.0     # total wall seconds (driver kills somewhere ~25min)
 PHASE_BUDGETS = {          # per-phase wall seconds (incl. compile)
+    "probe": 420.0,        # chipwatch subprocess probes + backoff — the
+                           # probes carry their own kill timeouts, this
+                           # is only the outer belt
+    "proxy": 600.0,        # CPU proxy train loop (compile-heavy)
     "preflight": 150.0,    # backend init + one tiny matmul: a wedged
                            # tunnel fails the round HERE, in ~2.5 min,
                            # instead of eating the alexnet budget
@@ -87,37 +127,123 @@ _state = {
     "deadline": _t_start + PHASE_BUDGETS["preflight"],
     "phase": "preflight",
     "primary_printed": False,
+    "primary_line": None,     # the emitted primary dict, for re-flush
+    "backend": "tpu",         # which rung of the ladder we're on
+    "stranded_phase": None,   # where the PREVIOUS run died (heartbeat)
     "extra": {},
 }
 _lock = threading.Lock()
 
 
-def _emit_primary(sps, extra, error=None, mfu=None):
+def _emit_primary(sps, extra, error=None, mfu=None, fresh_line=False,
+                  **fields):
     # ``mfu`` is the headline companion (vs 197 TFLOP/s bf16 peak);
     # ``vs_baseline`` keeps the legacy 375 samples/s/chip parity bar
     # for driver continuity only — it saturated at 53x in round 2 and
-    # carries no information (see docstring).
+    # carries no information (see docstring).  ``fields`` land
+    # top-level: proxy / backend / last_good / stranded_phase.
     line = {
         "metric": "alexnet_train_samples_per_sec_per_chip",
         "value": round(sps, 2) if sps else 0.0,
         "unit": "samples/s/chip",
         "mfu": round(mfu, 4) if mfu else 0.0,
         "vs_baseline": round(sps / PER_CHIP_BASELINE, 3) if sps else 0.0,
-        "extra": extra,
     }
+    line.update(fields)
+    line["extra"] = extra
     if error:
         line["error"] = error
-    print(json.dumps(line), flush=True)
+    out = json.dumps(line)
+    # fresh_line: the watchdog fires while the main thread may be mid-
+    # print — a leading newline guarantees THIS record starts at column
+    # 0 and stays parseable even glued after a half-written line.
+    print(("\n" + out) if fresh_line else out, flush=True)
+    return line
 
 
 def _write_side_file():
     try:
-        with open("BENCH_EXTRA.json", "w") as f:
+        with open(os.environ.get("FF_BENCH_EXTRA_PATH", "BENCH_EXTRA.json"),
+                  "w") as f:
             json.dump(_state["extra"], f, indent=1)
             f.flush()
             os.fsync(f.fileno())
     except Exception:
         pass
+
+
+def _ledger():
+    """tools/perf_ledger.py, loaded by file path (it is stdlib-only).
+    None when unavailable — ledger I/O must never kill a bench."""
+    try:
+        return _load_tool("perf_ledger")
+    except Exception:
+        return None
+
+
+def _ledger_append(line, status="ok", backend=None):
+    """One ledger entry per emitted result — real, proxy, or kill."""
+    try:
+        pl = _ledger()
+        if pl is None or not isinstance(line, dict):
+            return
+        entry = {"kind": "bench",
+                 "metric": line.get("metric"),
+                 "value": line.get("value", 0.0),
+                 "unit": line.get("unit"),
+                 "mfu": line.get("mfu"),
+                 "backend": backend or line.get("backend")
+                 or _state.get("backend", "tpu"),
+                 "proxy": bool(line.get("proxy")),
+                 "status": status}
+        ex = line.get("extra") or {}
+        batch = ((ex.get("alexnet") or {}).get("batch")
+                 or (ex.get("proxy") or {}).get("batch"))
+        if batch:
+            entry["batch"] = batch
+        prov = {}
+        if (ex.get("preflight") or {}).get("device"):
+            prov["device"] = ex["preflight"]["device"]
+        if isinstance(ex.get("proxy"), dict):
+            prov.update(ex["proxy"])
+        if line.get("proxy_reason"):
+            prov["proxy_reason"] = line["proxy_reason"]
+        if prov:
+            entry["provenance"] = prov
+        if line.get("stranded_phase"):
+            entry["stranded_phase"] = line["stranded_phase"]
+        if line.get("error"):
+            entry["error"] = str(line["error"])[:300]
+        pl.append_entry(entry)
+    except Exception:
+        pass
+
+
+def _last_good_summary():
+    """The cached last-good chip number from the perf ledger, shaped for
+    the result line — proxy rounds report it alongside so a trajectory
+    reader never mistakes 'no chip this round' for 'the chip got
+    slower'."""
+    try:
+        pl = _ledger()
+        lg = pl.last_good() if pl else None
+        if not lg:
+            return None
+        out = {"value": lg.get("value"), "unit": lg.get("unit"),
+               "commit": lg.get("commit")}
+        if lg.get("mfu"):
+            out["mfu"] = lg["mfu"]
+        if lg.get("unix_time"):
+            out["age_days"] = round(
+                (time.time() - lg["unix_time"]) / 86400.0, 1)
+        return out
+    except Exception:
+        return None
+
+
+def _stranded_fields():
+    s = _state.get("stranded_phase")
+    return {"stranded_phase": s} if s else {}
 
 
 def _heartbeat_detail():
@@ -134,6 +260,36 @@ def _heartbeat_detail():
         return None
 
 
+def _watchdog_fire(why, where, exit_fn=os._exit):
+    """Emit-then-exit.  Invariant: the LAST stdout line is ALWAYS a
+    complete, parseable JSON result — before the primary exists the
+    error line itself is that record; after, the primary is re-flushed
+    WHOLE on a fresh line (the main thread may have been mid-print of
+    the enriched line when the deadline hit, and a truncated final line
+    used to break BENCH_*.json tail parsing).  Every kill also leaves a
+    ledger entry."""
+    with _lock:
+        if not _state["primary_printed"]:
+            _state["extra"]["watchdog"] = f"killed in {where}"
+            line = _emit_primary(None, _state["extra"], fresh_line=True,
+                                 error=f"watchdog: {why} exceeded in {where} "
+                                       f"(TPU tunnel wedged?)",
+                                 **_stranded_fields())
+            _write_side_file()
+            _ledger_append(line, status="killed")
+            exit_fn(1)
+            return
+        # primary already on stdout: record what died, then re-flush the
+        # primary whole so the tail line stays parseable
+        _state["extra"]["watchdog"] = f"{why} exceeded during '{where}'"
+        _write_side_file()
+        line = dict(_state.get("primary_line") or {})
+        line["watchdog"] = _state["extra"]["watchdog"]
+        sys.stdout.write("\n" + json.dumps(line) + "\n")
+        sys.stdout.flush()
+        exit_fn(0)
+
+
 def _watchdog():
     while True:
         time.sleep(2.0)
@@ -145,20 +301,9 @@ def _watchdog():
                 continue
             why = ("global budget" if over_global else
                    f"phase '{_state['phase']}' budget")
-            hb = _heartbeat_detail()
-            where = _state["phase"] + (f" at {hb}" if hb else "")
-            if not _state["primary_printed"]:
-                _state["extra"]["watchdog"] = f"killed in {where}"
-                _emit_primary(None, _state["extra"],
-                              error=f"watchdog: {why} exceeded in {where} "
-                                    f"(TPU tunnel wedged?)")
-                _write_side_file()
-                os._exit(1)
-            # primary already on stdout: preserve it, record what died
-            _state["extra"]["watchdog"] = (
-                f"{why} exceeded during '{where}'")
-            _write_side_file()
-            os._exit(0)
+            phase = _state["phase"]
+        hb = _heartbeat_detail()
+        _watchdog_fire(why, phase + (f" at {hb}" if hb else ""))
 
 
 def _enter_phase(name):
@@ -186,6 +331,129 @@ def _telemetry_heartbeat(phase):
             log.flush()
     except Exception:
         pass
+
+
+def _read_stranded_phase():
+    """What the PREVIOUS bench run was doing when it died, from the
+    heartbeat file it left behind (wedged runs never clean up).  Must
+    run before this run's first heartbeat overwrites the file; the
+    result names the stranded phase in proxy/kill records so five
+    rc=1-value-0.0 rounds can never again hide WHERE they died.
+    FF_BENCH_STRANDED overrides (the proxy subprocess inherits the
+    parent's reading rather than its own fresh heartbeats)."""
+    env = os.environ.get("FF_BENCH_STRANDED")
+    if env is not None:
+        return env or None
+    try:
+        from flexflow_tpu.observability import health
+
+        hb = health.read_heartbeat()
+        if not hb:
+            return None
+        return health.describe_heartbeat(hb)
+    except Exception:
+        return None
+
+
+def _probe_chip(extra):
+    """Rung 1 of the ladder: does any chip answer?  Subprocess probes
+    via observability/chipwatch — a wedged tunnel kills the child,
+    never this process.  None when no chip answered."""
+    try:
+        from flexflow_tpu.observability import chipwatch
+    except Exception as e:
+        extra["probe"] = {"error": f"{type(e).__name__}: {e}"}
+        return None
+    _enter_phase("probe")
+    timeout = float(os.environ.get("FF_BENCH_PROBE_TIMEOUT", "90") or 90)
+    attempts = int(os.environ.get("FF_BENCH_PROBE_ATTEMPTS", "2") or 2)
+    res = chipwatch.wait_for_chip(budget_s=PHASE_BUDGETS["probe"] - 30.0,
+                                  probe_timeout=timeout,
+                                  initial_backoff=15.0,
+                                  max_probes=attempts)
+    extra["probe"] = ({"ok": True, "device_kind": res.device_kind,
+                       "latency_s": res.latency_s} if res is not None else
+                      {"ok": False, "attempts": attempts,
+                       "timeout_s": timeout})
+    return res
+
+
+PROXY_DTYPE = "float32"  # bf16 is emulated on XLA:CPU — a noisy proxy
+
+
+def _run_proxy(extra, reason):
+    """Rung 3: no chip answered (or proxy was forced) — produce a CPU
+    proxy metric instead of dying.  The number is stamped
+    ``"proxy": true`` with provenance and the cached last-good chip
+    number alongside, and the process exits 0: availability of the
+    measurement pipeline is the signal; the proxy value only tracks
+    gross CPU-side regressions (a broken train step, a 2x Python
+    overhead), never the chip."""
+    _enter_phase("proxy")
+    fields = {"proxy": True, "backend": "cpu", "proxy_reason": reason}
+    fields.update(_stranded_fields())
+    lg = _last_good_summary()
+    if lg:
+        fields["last_good"] = lg
+    batch = int(os.environ.get("FF_BENCH_PROXY_BATCH", "8") or 8)
+    steps = int(os.environ.get("FF_BENCH_PROXY_STEPS", "4") or 4)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        sps, tf, _ = run_one("alexnet", batch_size=batch,
+                             compute_dtype=PROXY_DTYPE, steps=steps)
+        extra["proxy"] = {"model": "alexnet", "batch": batch,
+                          "steps": steps, "dtype": PROXY_DTYPE,
+                          "backend": "cpu",
+                          "achieved_tflops": round(tf, 3)}
+        with _lock:
+            line = _emit_primary(sps, extra, **fields)
+            _state["primary_printed"] = True
+            _state["primary_line"] = line
+        _write_side_file()
+        _ledger_append(line, status="ok", backend="cpu")
+    except Exception as e:
+        line = _emit_primary(None, extra,
+                             error=f"proxy: {type(e).__name__}: {e}",
+                             **fields)
+        _write_side_file()
+        _ledger_append(line, status="error", backend="cpu")
+        sys.exit(1)
+
+
+def _try_proxy_subprocess():
+    """Rung 4: the probe passed but in-process init then failed or fell
+    back — this process's jax can no longer flip to CPU, so the proxy
+    runs in a fresh forced-proxy subprocess and its result line (which
+    the child also ledgers) is forwarded.  True iff the child produced
+    a good line."""
+    import subprocess
+
+    _enter_phase("proxy")
+    env = dict(os.environ, FF_BENCH_FORCE_PROXY="1", JAX_PLATFORMS="cpu",
+               FF_BENCH_STRANDED=_state.get("stranded_phase") or "")
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=PHASE_BUDGETS["proxy"] - 30.0)
+    except Exception:
+        return False
+    line = None
+    for raw in (r.stdout or "").splitlines():
+        try:
+            cand = json.loads(raw.strip())
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand:
+            line = cand
+    if r.returncode != 0 or line is None:
+        return False
+    with _lock:
+        print("\n" + json.dumps(line), flush=True)
+        _state["primary_printed"] = True
+        _state["primary_line"] = line
+    return True
 
 
 def _build(name, batch_size, compute_dtype, fused=False):
@@ -478,13 +746,38 @@ def main():
     # Heartbeat file for phase-level wedge attribution (the framework
     # rewrites it at every phase entry / step; the watchdog reads it).
     os.environ.setdefault("FF_HEARTBEAT_PATH", "BENCH_HEARTBEAT.json")
+    # the previous run's heartbeat names the phase IT stranded in —
+    # read before this run's first heartbeat overwrites the file
+    _state["stranded_phase"] = _read_stranded_phase()
     threading.Thread(target=_watchdog, daemon=True).start()
     # initial phase is set at module load, not via _enter_phase — emit
     # its heartbeat here (stdlib-only module: safe before jax init)
     _telemetry_heartbeat("preflight")
     extra = _state["extra"]
 
+    # ---- rung 1: does any chip answer?  (see ladder in the docstring) ----
+    force_proxy = os.environ.get("FF_BENCH_FORCE_PROXY", "") not in ("", "0")
+    allow_cpu = bool(os.environ.get("FF_BENCH_ALLOW_CPU"))
+    env_plat = (os.environ.get("JAX_PLATFORMS", "").split(",") + [""])[0]
+    if force_proxy:
+        reason = "forced by FF_BENCH_FORCE_PROXY"
+    elif env_plat == "cpu" and not allow_cpu:
+        # the caller pinned the cpu backend: no chip can answer by
+        # construction, skip the probe and degrade immediately
+        force_proxy = True
+        reason = "JAX_PLATFORMS=cpu pins the cpu backend"
+    elif not allow_cpu:
+        reason = ""
+        if _probe_chip(extra) is None:
+            force_proxy = True
+            reason = "no chip answered within probe budget (tunnel wedged?)"
+    if force_proxy:
+        _state["backend"] = "cpu"
+        _run_proxy(extra, reason)
+        return
+
     # ---- preflight: backend init + tiny matmul under a short deadline ----
+    _enter_phase("preflight")
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
@@ -499,18 +792,24 @@ def main():
             "backend_init_s": round(time.monotonic() - t_pf, 1),
             "platform": plat,
             "device": str(jax.devices()[0].device_kind)}
-        if plat == "cpu" and not os.environ.get("FF_BENCH_ALLOW_CPU"):
+        if plat == "cpu" and not allow_cpu:
             # jax silently falls back to its CPU backend when the TPU
             # plugin fails init — a CPU "samples/s/chip" number would be
-            # garbage against the TPU baseline; fail fast instead of
+            # garbage against the TPU baseline; degrade instead of
             # burning the alexnet budget discovering it
             raise RuntimeError(
                 "backend fell back to 'cpu' (TPU unreachable); set "
                 "FF_BENCH_ALLOW_CPU=1 for a structural CPU run")
     except Exception as e:  # init failed fast — still emit the line
-        _emit_primary(None, extra,
-                      error=f"preflight: {type(e).__name__}: {e}")
+        line = _emit_primary(None, extra,
+                             error=f"preflight: {type(e).__name__}: {e}",
+                             **_stranded_fields())
         _write_side_file()
+        _ledger_append(line, status="error")
+        # rung 4: the probe said a chip was there — degrade to a proxy
+        # subprocess rather than leaving the round with no result
+        if not allow_cpu and _try_proxy_subprocess():
+            return
         raise
 
     # ---- primary phase: nothing runs before this number is on stdout ----
@@ -519,8 +818,10 @@ def main():
         sps_a, tf_a, mfu_a = run_one("alexnet",
                                      batch_size=BENCH_SINGLE_CHIP_BATCH)
     except Exception as e:
-        _emit_primary(None, extra, error=f"{type(e).__name__}: {e}")
+        line = _emit_primary(None, extra, error=f"{type(e).__name__}: {e}",
+                             **_stranded_fields())
         _write_side_file()
+        _ledger_append(line, status="error", backend=plat)
         raise
     extra["alexnet"] = {"samples_per_sec_per_chip": round(sps_a, 2),
                         "achieved_tflops": round(tf_a, 1),
@@ -530,9 +831,12 @@ def main():
                         # ACTUALLY used (chip_session.sh stage 3)
                         "batch": BENCH_SINGLE_CHIP_BATCH}
     with _lock:
-        _emit_primary(sps_a, {"alexnet": extra["alexnet"]}, mfu=mfu_a)
+        line = _emit_primary(sps_a, {"alexnet": extra["alexnet"]},
+                             mfu=mfu_a, backend=plat)
         _state["primary_printed"] = True
+        _state["primary_line"] = line
     _write_side_file()
+    _ledger_append(line, status="ok", backend=plat)
 
     # ---- extras: best-effort, each under its own deadline ----
     _extra_phases(extra)
@@ -541,7 +845,8 @@ def main():
     # enriched with all extras (a tail parser picking either line sees
     # the identical metric/value).
     with _lock:
-        _emit_primary(sps_a, extra, mfu=mfu_a)
+        _state["primary_line"] = _emit_primary(sps_a, extra, mfu=mfu_a,
+                                               backend=plat)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,12 @@ the hot path performs ZERO event-log calls — every site guards on a
 ``agreement`` — continuous simulator validation: predicted per-op /
                 per-step times diffed against measured walls as
                 ``sim_prediction`` / ``sim_divergence`` events.
+``chipwatch`` — the opportunistic chip-session layer: subprocess TPU
+                probes with capped backoff (a wedged tunnel kills the
+                child, never the parent), and first-healthy-window
+                conversion into durable measurement artifacts
+                (``chip_probe`` / ``chip_window`` /
+                ``measurement_progress`` events).
 ``searchtrace`` — the search flight recorder: per-proposal
                 ``search_candidate`` events from the MCMC engines,
                 per-op "why this config" summaries (incl. best
@@ -36,11 +42,11 @@ the hot path performs ZERO event-log calls — every site guards on a
                 ``--diff``).
 """
 
-from . import events, health, searchtrace
+from . import chipwatch, events, health, searchtrace
 from .events import EventLog, active_log, for_config
 from .health import HealthMonitor, read_heartbeat, write_heartbeat
 from .searchtrace import SearchRecorder
 
 __all__ = ["EventLog", "HealthMonitor", "SearchRecorder", "active_log",
-           "events", "for_config", "health", "read_heartbeat",
+           "chipwatch", "events", "for_config", "health", "read_heartbeat",
            "searchtrace", "write_heartbeat"]

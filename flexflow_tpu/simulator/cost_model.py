@@ -71,7 +71,13 @@ class CostModel:
 
     def _persist(self, key: str, t: float):
         """Append one measured entry to the local cache (read-modify-write
-        so concurrent tools don't clobber each other's keys)."""
+        so concurrent tools don't clobber each other's keys).
+
+        The write is atomic tmp+rename: calibration windows get KILLED —
+        watchdogs, wedged tunnels, chipwatch reclaiming a window — and a
+        direct ``open(path, "w")`` caught mid-write would truncate every
+        entry the window had already paid for.  With the rename, readers
+        (and the next resumed worker) always see a complete cache."""
         if not self.cache_path:
             return
         try:
@@ -86,8 +92,12 @@ class CostModel:
             data = {k: v for k, v in data.items() if isinstance(v, dict)}
             data[key] = {"t": t, "measured": True,
                          "platform": self.target_platform}
-            with open(self.cache_path, "w") as f:
+            tmp = f"{self.cache_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
                 json.dump(data, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.cache_path)
         except OSError:
             pass
 

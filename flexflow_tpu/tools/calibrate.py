@@ -715,8 +715,14 @@ def main(argv: Optional[List[str]] = None):
             if dk in prev or dk in merged:
                 merged[dk] = {**prev.get(dk, {}), **merged.get(dk, {})}
         merged = {**prev, **merged}
-        with open(fit_out, "w") as f:
+        # atomic: a kill mid-write must not truncate the machine fit
+        # (same rationale as CostModel._persist)
+        tmp = f"{fit_out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(merged, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fit_out)
         pcie = (f" pcie={merged['pcie_bandwidth'] / 1e9:.1f}GB/s"
                 if "pcie_bandwidth" in merged else "")
         if fit:
@@ -731,6 +737,24 @@ def main(argv: Optional[List[str]] = None):
             print(f"[calibrate] roofline unfitted (no op records); "
                   f"host-transfer fit landed:{pcie} -> {fit_out}")
     print(f"[calibrate] measured cache: {len(cost._measured)} entries -> {out}")
+
+    if not args.worker:
+        # One perf-ledger entry per calibration session: CALIBRATION.md's
+        # provenance-coverage table and doctor's "perf" section read the
+        # measurement trajectory from here.  Never fatal.
+        try:
+            from . import perf_ledger
+
+            entry = {"kind": "calibration", "backend": platform,
+                     "entries": len(cost._measured),
+                     "fit_only": bool(args.fit_only), "cache": out}
+            if fit:
+                entry["fit_points"] = fit.get("fit_points")
+                entry["fit_log_rmse"] = fit.get("fit_log_rmse")
+            perf_ledger.append_entry(entry)
+        except Exception as e:  # noqa: BLE001
+            print(f"[calibrate] ledger append failed: "
+                  f"{type(e).__name__}: {e}")
 
 
 if __name__ == "__main__":

@@ -164,6 +164,63 @@ def _serving():
     return ", ".join(bits)
 
 
+def _perf(probe: bool):
+    # The perf observatory's state at a glance: is a chip reachable
+    # right now (subprocess, 10s cap — never hangs the doctor), how much
+    # of the cost model is grounded in real measurements, and how stale
+    # is the last good bench number in the perf ledger.
+    import json as _json
+    import time as _time
+
+    from ..observability import chipwatch
+    from ..simulator import cost_model as cm
+    from . import perf_ledger
+    from .report_configs import CALIBRATION_TARGET_ENTRIES
+
+    bits = []
+    if probe:
+        res = chipwatch.probe_once(timeout=10.0)
+        bits.append(f"chip probe: ok [{res.device_kind}] "
+                    f"in {res.latency_s:.1f}s" if res.ok else
+                    f"chip probe: unreachable ({res.detail})")
+    else:
+        bits.append("chip probe: skipped")
+
+    fams = {}
+    n_measured = 0
+    try:
+        with open(cm.MEASURED_CACHE) as f:
+            for k, v in _json.load(f).items():
+                if (isinstance(v, dict) and v.get("measured")
+                        and v.get("platform", "tpu") == "tpu"):
+                    n_measured += 1
+                    fams[k.split(":", 1)[0]] = fams.get(
+                        k.split(":", 1)[0], 0) + 1
+    except (OSError, ValueError):
+        pass
+    if n_measured:
+        by_fam = ", ".join(f"{k}:{fams[k]}"
+                           for k in sorted(fams, key=fams.get, reverse=True))
+        cov = n_measured / CALIBRATION_TARGET_ENTRIES
+        bits.append(f"measured cache: {n_measured} tpu entries "
+                    f"({by_fam}; {cov:.0%} of the "
+                    f"{CALIBRATION_TARGET_ENTRIES}-entry target — "
+                    "the rest costs analytically)")
+    else:
+        bits.append("measured cache: EMPTY — every op costs analytically")
+
+    lg = perf_ledger.last_good()
+    if lg:
+        age = (_time.time() - lg.get("unix_time", 0)) / 86400.0
+        bits.append(f"last good bench: {lg.get('value'):.0f} "
+                    f"{lg.get('unit', '')} @ {lg.get('commit') or '?'} "
+                    f"({age:.1f}d ago)")
+    else:
+        bits.append("last good bench: none in ledger "
+                    f"({perf_ledger.default_path()})")
+    return ", ".join(bits)
+
+
 def _cpu_train():
     import jax
 
@@ -209,6 +266,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     plan += [("native libs", _native_libs, False),
              ("optional deps", _optional_deps, False),
              ("observability", _observability, False),
+             ("perf", lambda: _perf(probe=not args.skip_accelerator), False),
              ("resilience", _resilience, False),
              ("serving", _serving, False),
              ("cpu training", _cpu_train, True)]

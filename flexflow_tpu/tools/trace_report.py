@@ -229,6 +229,54 @@ def render_report(records: List[Dict[str, Any]], top_k: int = 8) -> str:
                          f"{float(e.get('ts', 0.0)):.2f} |")
         lines.append("")
 
+    # ---- measurement (chipwatch chip-session layer) -------------------
+    probes = events.get("chip_probe", [])
+    progress = events.get("measurement_progress", [])
+    windows = events.get("chip_window", [])
+    if probes or progress or windows:
+        lines.append("## Measurement")
+        lines.append("")
+        if probes:
+            ok = sum(1 for e in probes
+                     if e.get("attrs", {}).get("ok"))
+            lines.append(f"- chip probes: {len(probes)} "
+                         f"({ok} ok, {len(probes) - ok} failed)")
+            lines.append("")
+            lines.append("| ts s | attempt | ok | latency s | detail |")
+            lines.append("|---|---|---|---|---|")
+            for e in probes[-12:]:
+                a = e.get("attrs", {})
+                lines.append(
+                    "| {:.2f} | {} | {} | {} | {} |".format(
+                        float(e.get("ts", 0.0)), a.get("attempt", "?"),
+                        "yes" if a.get("ok") else "no",
+                        a.get("latency_s", "?"),
+                        a.get("device_kind") or a.get("detail") or ""))
+            lines.append("")
+        if progress:
+            a0 = progress[0].get("attrs", {})
+            a1 = progress[-1].get("attrs", {})
+            start = a0.get("entries", 0) - a0.get("new_entries", 0)
+            lines.append(
+                f"- measured-cache growth: {start} -> "
+                f"{a1.get('entries', '?')} entries "
+                f"(+{a1.get('new_entries', '?')}) over "
+                f"{a1.get('elapsed_s', '?')}s in "
+                f"{len(progress)} increments")
+            lines.append("")
+        for e in windows:
+            a = e.get("attrs", {})
+            verdict = "converted" if a.get("converted") else "NOT converted"
+            detail = f" — {a['detail']}" if a.get("detail") else ""
+            lines.append(
+                f"- window {verdict}: {a.get('entries_before', '?')} -> "
+                f"{a.get('entries_after', '?')} entries in "
+                f"{a.get('duration_s', '?')}s (measure rc "
+                f"{a.get('measure_rc')}, refit rc "
+                f"{a.get('refit_rc')}){detail}")
+        if windows:
+            lines.append("")
+
     # ---- search progress ----------------------------------------------
     prog = events.get("search_progress", [])
     if prog:

@@ -38,6 +38,45 @@ grep -q '"phase"' "$HEARTBEAT" \
   || { echo "health smoke: heartbeat file missing/empty"; exit 1; }
 echo "telemetry+health smoke: OK ($(wc -l < "$TRACE") trace records)"
 
+# Degradation-ladder smoke: with no chip attached, bench.py must DEGRADE
+# (CPU proxy metric stamped proxy:true, rc=0, a parseable perf-ledger
+# entry) instead of dying — the "bench never returns rc=1 without a
+# result line" contract (docs/observability.md "Chip-session perf
+# observatory").
+PROXY_OUT="$SMOKE_DIR/bench_proxy.out"
+FF_BENCH_FORCE_PROXY=1 FF_BENCH_PROXY_BATCH=8 FF_BENCH_PROXY_STEPS=2 \
+  FF_PERF_LEDGER="$SMOKE_DIR/ledger.jsonl" \
+  FF_BENCH_EXTRA_PATH="$SMOKE_DIR/bench_extra.json" \
+  FF_HEARTBEAT_PATH="$SMOKE_DIR/bench_hb.json" \
+  python bench.py > "$PROXY_OUT" \
+  || { echo "proxy bench smoke: bench.py exited non-zero"; exit 1; }
+python - "$PROXY_OUT" "$SMOKE_DIR/ledger.jsonl" <<'EOF' \
+  || { echo "proxy bench smoke: result/ledger acceptance failed"; exit 1; }
+import json, sys
+lines = []
+for raw in open(sys.argv[1]):
+    try:
+        lines.append(json.loads(raw.strip()))
+    except ValueError:
+        pass
+assert lines, "no JSON result line on stdout"
+r = lines[-1]
+assert r.get("proxy") is True and r.get("backend") == "cpu", r
+assert r.get("value", 0) > 0, r
+entries = [json.loads(l) for l in open(sys.argv[2]) if l.strip()]
+assert entries, "no ledger entry"
+e = entries[-1]
+assert e["proxy"] and e["status"] == "ok" and "commit" in e, e
+EOF
+python -m flexflow_tpu.tools.perf_ledger report \
+    --ledger "$SMOKE_DIR/ledger.jsonl" | grep -q "# Perf ledger" \
+  || { echo "proxy bench smoke: ledger report failed"; exit 1; }
+echo "proxy bench smoke: OK ($(python -c "
+import json, sys
+lines = [l for l in open('$PROXY_OUT') if l.strip().startswith('{')]
+r = json.loads(lines[-1])
+print(f\"{r['value']} {r['unit']} (proxy)\")" ))"
+
 # Search-observability smoke: a seeded tiny-budget search must produce a
 # candidate-level trace + provenance sidecar, search_report must explain
 # it, and --diff must name changed ops vs the shipped strategy

@@ -1,0 +1,161 @@
+"""bench.py degradation-ladder tests: the watchdog's last-line-always-
+parseable invariant, stranded-phase attribution, ledger wiring, and
+(slow) the forced-proxy acceptance run — ``JAX_PLATFORMS=cpu python
+bench.py`` must exit 0 with a well-formed ``proxy: true`` result."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+import bench  # noqa: E402
+
+REPO = os.path.dirname(os.path.abspath(bench.__file__))
+
+
+@pytest.fixture
+def bench_state():
+    """Snapshot/restore the bench module state the unit tests mutate."""
+    saved = dict(bench._state)
+    saved_extra = dict(bench._state["extra"])
+    yield bench._state
+    bench._state.update(saved)
+    bench._state["extra"] = saved_extra
+
+
+def _last_json(out):
+    lines = [l for l in out.splitlines() if l.strip()]
+    return json.loads(lines[-1])
+
+
+def test_emit_primary_fields_land_top_level(capsys):
+    line = bench._emit_primary(100.0, {"alexnet": {"batch": 256}},
+                               mfu=0.25, proxy=True, backend="cpu",
+                               stranded_phase="phase 'preflight'")
+    out = capsys.readouterr().out
+    assert _last_json(out) == line
+    assert line["value"] == 100.0 and line["mfu"] == 0.25
+    assert line["proxy"] is True and line["backend"] == "cpu"
+    assert line["stranded_phase"] == "phase 'preflight'"
+    assert line["extra"] == {"alexnet": {"batch": 256}}
+
+
+def test_emit_primary_fresh_line_starts_at_column_zero(capsys):
+    sys.stdout.write("half-written enriched li")  # no newline — mid-print
+    bench._emit_primary(50.0, {}, fresh_line=True)
+    out = capsys.readouterr().out
+    assert _last_json(out)["value"] == 50.0  # tail line parses anyway
+
+
+def test_read_stranded_phase_env_override(monkeypatch):
+    monkeypatch.setenv("FF_BENCH_STRANDED", "phase 'alexnet' (120s stale)")
+    assert bench._read_stranded_phase() == "phase 'alexnet' (120s stale)"
+    monkeypatch.setenv("FF_BENCH_STRANDED", "")
+    assert bench._read_stranded_phase() is None  # child with no parent info
+
+
+def test_read_stranded_phase_from_heartbeat(tmp_path, monkeypatch):
+    from flexflow_tpu.observability import health
+
+    monkeypatch.delenv("FF_BENCH_STRANDED", raising=False)
+    monkeypatch.setenv("FF_HEARTBEAT_PATH", str(tmp_path / "hb.json"))
+    assert bench._read_stranded_phase() is None  # no previous run
+    health.write_heartbeat("alexnet", step=7)
+    desc = bench._read_stranded_phase()
+    assert "alexnet" in desc and "step 7" in desc
+
+
+def test_watchdog_fire_before_primary(tmp_path, monkeypatch, capsys,
+                                      bench_state):
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("FF_PERF_LEDGER", str(ledger))
+    monkeypatch.setenv("FF_BENCH_EXTRA_PATH", str(tmp_path / "extra.json"))
+    bench_state["primary_printed"] = False
+    bench_state["stranded_phase"] = "phase 'preflight' (90s stale)"
+    codes = []
+    bench._watchdog_fire("phase 'preflight' budget", "preflight",
+                         exit_fn=codes.append)
+    assert codes == [1]  # no result -> rc 1
+    rec = _last_json(capsys.readouterr().out)
+    assert "watchdog" in rec["error"] and rec["value"] == 0.0
+    assert rec["stranded_phase"] == "phase 'preflight' (90s stale)"
+    entries = [json.loads(l) for l in ledger.read_text().splitlines()]
+    assert entries[-1]["status"] == "killed"
+
+
+def test_watchdog_fire_reflushes_primary_whole(tmp_path, monkeypatch,
+                                               capsys, bench_state):
+    monkeypatch.setenv("FF_BENCH_EXTRA_PATH", str(tmp_path / "extra.json"))
+    primary = {"metric": "alexnet_train_samples_per_sec_per_chip",
+               "value": 16902.0, "unit": "samples/s/chip", "mfu": 0.367}
+    bench_state["primary_printed"] = True
+    bench_state["primary_line"] = dict(primary)
+    codes = []
+    sys.stdout.write('{"metric": "alexnet_tr')  # main thread died mid-print
+    bench._watchdog_fire("phase 'decode' budget", "decode",
+                         exit_fn=codes.append)
+    assert codes == [0]  # the primary made it out -> rc 0
+    rec = _last_json(capsys.readouterr().out)
+    assert rec["value"] == 16902.0 and rec["mfu"] == 0.367
+    assert "decode" in rec["watchdog"]
+
+
+def test_ledger_append_carries_provenance(tmp_path, monkeypatch):
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("FF_PERF_LEDGER", str(ledger))
+    line = {"metric": "alexnet_train_samples_per_sec_per_chip",
+            "value": 41.5, "unit": "samples/s/chip", "mfu": 0.0,
+            "proxy": True, "proxy_reason": "no chip answered",
+            "stranded_phase": "phase 'alexnet'",
+            "extra": {"proxy": {"model": "alexnet", "batch": 8,
+                                "dtype": "float32", "backend": "cpu"}}}
+    bench._ledger_append(line, status="ok", backend="cpu")
+    e = json.loads(ledger.read_text().splitlines()[-1])
+    assert e["proxy"] is True and e["backend"] == "cpu"
+    assert e["batch"] == 8
+    assert e["provenance"]["proxy_reason"] == "no chip answered"
+    assert e["stranded_phase"] == "phase 'alexnet'"
+    assert "commit" in e and "unix_time" in e
+
+
+def test_last_good_summary_reads_ledger(tmp_path, monkeypatch):
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("FF_PERF_LEDGER", str(ledger))
+    pl = bench._ledger()
+    pl.append_entry({"kind": "bench", "metric": "m", "value": 16902.0,
+                     "unit": "samples/s/chip", "mfu": 0.367,
+                     "status": "ok", "proxy": False}, path=str(ledger))
+    lg = bench._last_good_summary()
+    assert lg["value"] == 16902.0 and lg["mfu"] == 0.367
+    assert "age_days" in lg
+
+
+@pytest.mark.slow
+def test_forced_proxy_bench_exits_zero(tmp_path):
+    """The acceptance run: no chip (JAX_PLATFORMS=cpu), bench.py must
+    degrade to a proxy metric and exit 0 — not die with rc=1/value 0."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               FF_BENCH_PROXY_BATCH="8", FF_BENCH_PROXY_STEPS="2",
+               FF_PERF_LEDGER=str(tmp_path / "ledger.jsonl"),
+               FF_BENCH_EXTRA_PATH=str(tmp_path / "extra.json"),
+               FF_HEARTBEAT_PATH=str(tmp_path / "hb.json"))
+    env.pop("FF_BENCH_FORCE_PROXY", None)  # the cpu pin alone must do it
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rec = _last_json(r.stdout)
+    assert rec["proxy"] is True and rec["backend"] == "cpu"
+    assert rec["value"] > 0
+    assert "cpu" in rec["proxy_reason"]
+    entries = [json.loads(l)
+               for l in open(tmp_path / "ledger.jsonl") if l.strip()]
+    assert entries[-1]["proxy"] and entries[-1]["status"] == "ok"
+    # the side file survived too
+    extra = json.load(open(tmp_path / "extra.json"))
+    assert extra["proxy"]["backend"] == "cpu"

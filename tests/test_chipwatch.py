@@ -1,0 +1,240 @@
+"""Chipwatch tests: subprocess probes (never hang the parent), capped
+backoff, and the window-conversion invariant the whole layer exists
+for — a KILLED measurement subprocess still leaves a readable,
+monotonically grown measured cache (docs/observability.md "Chip-session
+perf observatory")."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.observability import chipwatch  # noqa: E402
+from flexflow_tpu.observability import events  # noqa: E402
+
+# Fake probe commands: plain python -c, no jax import — fast.
+PROBE_OK = [sys.executable, "-c", "print('TPU_OK fake_v5e 1.0')"]
+PROBE_FAIL = [sys.executable, "-c",
+              "import sys; print('no tpu', file=sys.stderr); sys.exit(1)"]
+PROBE_HANG = [sys.executable, "-c", "import time; time.sleep(600)"]
+
+# Fake measurement backend: grows a measured-cache file one entry at a
+# time (atomic tmp+rename, like CostModel._persist), resuming from
+# whatever a previous interrupted window already persisted.
+FAKE_MEASURE = r"""
+import json, os, sys, time
+path, n, delay = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+data = {}
+if os.path.exists(path):
+    data = json.load(open(path))
+start = len(data)
+for i in range(start, start + n):
+    data[f"FakeOp:({i},):():k:bfloat16:forward"] = {
+        "t": 1e-4, "measured": True, "platform": "tpu"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+    time.sleep(delay)
+"""
+
+
+def _measure_cmd(cache, n, delay):
+    return [sys.executable, "-c", FAKE_MEASURE, cache, str(n), str(delay)]
+
+
+def test_probe_once_ok():
+    res = chipwatch.probe_once(timeout=30.0, probe_cmd=PROBE_OK)
+    assert res.ok and res.device_kind == "fake_v5e"
+    assert res.latency_s >= 0
+
+
+def test_probe_once_failure_carries_stderr():
+    res = chipwatch.probe_once(timeout=30.0, probe_cmd=PROBE_FAIL)
+    assert not res.ok
+    assert "no tpu" in res.detail
+
+
+def test_probe_once_kills_wedged_child():
+    t0 = time.monotonic()
+    res = chipwatch.probe_once(timeout=1.0, probe_cmd=PROBE_HANG)
+    assert not res.ok
+    assert "wedged" in res.detail
+    # the parent must come back promptly — the child was killed, the
+    # 600s sleep never ran to completion
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_backoff_is_capped():
+    delays = chipwatch.backoff_delays(initial=10.0, factor=2.0, cap=35.0)
+    got = [next(delays) for _ in range(5)]
+    assert got == [10.0, 20.0, 35.0, 35.0, 35.0]
+
+
+def test_wait_for_chip_backs_off_then_gives_up():
+    slept = []
+    res = chipwatch.wait_for_chip(budget_s=3600.0, probe_timeout=30.0,
+                                  probe_cmd=PROBE_FAIL,
+                                  initial_backoff=0.25, backoff_factor=2.0,
+                                  backoff_cap=0.6, max_probes=4,
+                                  sleep=slept.append)
+    assert res is None
+    assert slept == [0.25, 0.5, 0.6]  # no sleep after the final probe
+
+
+def test_wait_for_chip_returns_first_success():
+    slept = []
+    res = chipwatch.wait_for_chip(budget_s=3600.0, probe_timeout=30.0,
+                                  probe_cmd=PROBE_OK, max_probes=5,
+                                  sleep=slept.append)
+    assert res is not None and res.ok
+    assert slept == []
+
+
+def test_wait_for_chip_respects_budget():
+    # budget smaller than the first backoff -> exactly one probe
+    slept = []
+    res = chipwatch.wait_for_chip(budget_s=0.1, probe_timeout=30.0,
+                                  probe_cmd=PROBE_FAIL,
+                                  initial_backoff=5.0, sleep=slept.append)
+    assert res is None and slept == []
+
+
+def test_read_measured_count_filters_platform(tmp_path):
+    p = tmp_path / "cache.json"
+    p.write_text(json.dumps({
+        "a": {"t": 1e-3, "measured": True, "platform": "tpu"},
+        "b": {"t": 1e-3, "measured": True, "platform": "cpu"},
+        "c": {"t": 1e-3, "measured": False, "platform": "tpu"},
+        "d": "legacy-bare-float"}))
+    assert chipwatch.read_measured_count(str(p), "tpu") == 1
+    assert chipwatch.read_measured_count(str(tmp_path / "missing.json")) == 0
+    p.write_text('{"torn mid-wri')
+    assert chipwatch.read_measured_count(str(p)) is None
+
+
+def test_convert_window_completes_and_counts(tmp_path):
+    cache = str(tmp_path / "measured.json")
+    win = chipwatch.convert_window(
+        cache_path=cache, measure_cmd=_measure_cmd(cache, 5, 0.01),
+        max_seconds=30.0, poll_every=0.05, refit=False)
+    assert win.converted
+    assert win.entries_before == 0 and win.entries_after == 5
+    assert win.measure_rc == 0
+    assert win.refit_rc is None  # refit=False
+    json.load(open(cache))  # cache is valid JSON
+
+
+def test_interrupted_window_grows_cache_monotonically(tmp_path):
+    """The acceptance-criteria test: a chipwatch window whose
+    measurement subprocess is KILLED mid-run (budget exhausted — the
+    wedged-tunnel stand-in) still leaves a readable cache, and a second
+    interrupted window resumes and grows it MONOTONICALLY."""
+    cache = str(tmp_path / "measured.json")
+    # the fake backend wants 500 entries at 50ms each (~25s); the
+    # window budget kills it after ~0.5s
+    win1 = chipwatch.convert_window(
+        cache_path=cache, measure_cmd=_measure_cmd(cache, 500, 0.05),
+        max_seconds=0.5, grace=0.0, poll_every=0.05, refit=False)
+    assert win1.converted, win1
+    assert win1.measure_rc != 0  # it really was killed
+    n1 = chipwatch.read_measured_count(cache)
+    assert n1 == win1.entries_after
+    assert 0 < n1 < 500
+    json.load(open(cache))  # no partial JSON despite the kill
+    # second window: resumes from the durable cache, grows it further
+    win2 = chipwatch.convert_window(
+        cache_path=cache, measure_cmd=_measure_cmd(cache, 500, 0.05),
+        max_seconds=0.5, grace=0.0, poll_every=0.05, refit=False)
+    assert win2.entries_before == n1
+    assert win2.entries_after > win2.entries_before
+    assert chipwatch.read_measured_count(cache) >= n1
+
+
+def test_convert_window_stall_kill(tmp_path):
+    cache = str(tmp_path / "measured.json")
+    # one entry, then the "backend" hangs without producing more
+    hang = [sys.executable, "-c", FAKE_MEASURE.replace(
+        "time.sleep(delay)", "time.sleep(delay if i > start else 600)"),
+        cache, "5", "0.01"]
+    win = chipwatch.convert_window(
+        cache_path=cache, measure_cmd=hang, max_seconds=60.0,
+        poll_every=0.05, stall_timeout=0.5, refit=False)
+    assert win.converted and win.entries_after == 1
+    assert "no cache growth" in win.detail
+
+
+def test_window_emits_telemetry_events(tmp_path, monkeypatch):
+    trace = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", str(trace))
+    events.reset_active()
+    try:
+        cache = str(tmp_path / "measured.json")
+        chipwatch.probe_once(timeout=30.0, probe_cmd=PROBE_FAIL)
+        chipwatch.convert_window(
+            cache_path=cache, measure_cmd=_measure_cmd(cache, 3, 0.01),
+            max_seconds=30.0, poll_every=0.05, refit=False)
+    finally:
+        events.reset_active()
+    names = [json.loads(l)["name"] for l in trace.read_text().splitlines()
+             if '"name"' in l]
+    assert "chip_probe" in names
+    assert "measurement_progress" in names
+    assert "chip_window" in names
+    # and trace_report folds them into a Measurement section
+    from flexflow_tpu.tools import trace_report
+
+    rep = trace_report.render_report(trace_report.parse_trace(str(trace)))
+    assert "## Measurement" in rep
+    assert "window converted" in rep
+
+
+def test_cost_model_persist_survives_sigkill(tmp_path):
+    """CostModel._persist is atomic tmp+rename: SIGKILL a process that
+    persists in a tight loop, the cache must still parse."""
+    cache = str(tmp_path / "simcache.json")
+    code = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from flexflow_tpu.simulator.cost_model import CostModel\n"
+        "from flexflow_tpu.simulator.machine import TPUMachineModel\n"
+        "cm = CostModel(TPUMachineModel(num_devices=1), cache_path=%r)\n"
+        "print('READY', flush=True)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    cm._persist(f'Dense:({8},):():h{i}:bfloat16:forward', 1e-4)\n"
+        "    i += 1\n" % (os.getcwd(), cache))
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().startswith("READY")
+        deadline = time.monotonic() + 20.0
+        while not os.path.exists(cache) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.3)  # let many read-modify-write cycles run
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    data = json.load(open(cache))  # would raise on a torn write
+    assert len(data) >= 1
+    assert all(v.get("measured") for v in data.values())
+
+
+def test_chipwatch_probe_only_cli(tmp_path, capsys):
+    # --probe-only against the real probe code would need a chip; the
+    # CLI is exercised through probe_once's injectable path elsewhere —
+    # here just check the module entrypoint parses args and reports a
+    # failed probe as rc 1 (PROBE_CODE asserts platform=='tpu', and the
+    # test suite pins cpu).
+    rc = chipwatch.main(["--probe-only", "--probe-timeout", "60"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rc == 1 and rec["ok"] is False

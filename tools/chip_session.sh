@@ -266,6 +266,7 @@ fi
 # missing optional artifact (e.g. SKIP_SWEEP) must not abort the commit.
 ARTS=""
 for f in BENCH_EXTRA.json BENCH_SWEEP.md PROFILE_v5e.md CALIBRATION.md \
+         PERF_LEDGER.jsonl \
          REPORT_SOAP.md REPORT_SOAP_NMT.md REPORT_SOAP_DLRM.md \
          REPORT_SOAP_RESNET.md REPORT_SOAP_INCEPTION.md \
          flexflow_tpu/simulator/measured_v5e.json \
