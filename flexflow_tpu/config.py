@@ -28,6 +28,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 MAX_DIM = 4
 MAX_NUM_WORKERS = 1024
 
+# Default MCMC budget for offline/auto search entry points.  Sized for
+# the delta (incremental) simulator in simulator/delta.py, which re-costs
+# a proposal ~20x cheaper than the full task-graph rebuild the old
+# 1000-2000 defaults were calibrated against — more budget at lower cost
+# than before (set FF_SIM_DELTA=0 to get the old per-proposal price).
+DEFAULT_SEARCH_BUDGET = 8000
+
 
 class DeviceType(enum.Enum):
     """Device kind an op is placed on.

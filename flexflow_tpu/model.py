@@ -2030,6 +2030,9 @@ class FFModel:
             self._eval_step_fn = self._build_eval_step()
         params_in, batch_in = self._eval_inputs()
         msum, _ = self._eval_step_fn(params_in, self._stats, batch_in)
+        # one device fetch for the whole metric dict, split on host —
+        # per-key float(v) would round-trip to the device once per metric
+        msum = jax.device_get(msum)
         return {k: float(v) for k, v in msum.items()}
 
     def predict_batch(self) -> np.ndarray:

@@ -163,10 +163,16 @@ class SearchRecorder:
 
     def finish(self, best: Optional[Dict[str, Any]] = None,
                best_ms: Optional[float] = None,
-               initial_ms: Optional[float] = None) -> None:
+               initial_ms: Optional[float] = None,
+               proposals_per_s: Optional[float] = None,
+               delta: Optional[bool] = None) -> None:
         """Emit the per-op summaries (one per op in the FINAL strategy,
         including ops the proposal stream never touched — the report's
-        "why" table must cover every op) and the run summary."""
+        "why" table must cover every op) and the run summary.
+        ``proposals_per_s``/``delta`` record search throughput and
+        whether the incremental (delta) simulator was active at the end
+        of the run — the numbers behind the ``search_throughput`` perf-
+        ledger metric."""
         if initial_ms is not None:
             self._initial_ms = initial_ms
         if best_ms is not None:
@@ -198,6 +204,10 @@ class SearchRecorder:
             attrs["best_ms"] = _r3(self._best_ms)
         if self._last_improve is not None:
             attrs["last_improve_iter"] = int(self._last_improve)
+        if proposals_per_s is not None:
+            attrs["proposals_per_s"] = round(proposals_per_s, 1)
+        if delta is not None:
+            attrs["delta"] = bool(delta)
         self.log.event("search_summary", **attrs)
 
 

@@ -44,11 +44,16 @@ class DataLoader:
         self._rng = np.random.default_rng(seed)
         self._order = np.arange(self.num_samples)
         self.next_index = 0
-        # Double buffering: the NEXT batch's host gather runs on a worker
-        # thread while the device computes the current step (the
-        # reference's scatter index-launch likewise overlaps with compute
-        # under Legion's dependence analysis).  device_put stays on the
-        # calling thread — only the numpy gather moves.
+        # Double buffering: the NEXT batch's host gather AND its sharded
+        # jax.device_put both run on a worker thread while the device
+        # computes the current step (the reference's scatter index-launch
+        # likewise overlaps with compute under Legion's dependence
+        # analysis).  set_batch sees committed jax.Arrays and passes them
+        # through, so the host->device copy overlaps the running step
+        # instead of serializing inside next_batch.  Host-embedding index
+        # inputs stay numpy (set_batch keeps a host copy for the sparse
+        # gather), as does anything staging can't place — it falls back
+        # to the raw gather result.
         self.prefetch = prefetch
         self._pool = None
         self._pending = None   # (start_index, order_version, future)
@@ -114,6 +119,32 @@ class DataLoader:
         return ({t: gather_rows(a, sel) for t, a in self.inputs.items()},
                 gather_rows(self.labels, sel))
 
+    def _stage(self, start: int):
+        """Worker-thread body: gather the batch, then pre-place each
+        tensor on device with the same sharding set_batch would use
+        (_place_batch passes committed arrays through untouched).  Any
+        failure — model not compiled yet, no machine, odd tensor —
+        degrades to handing set_batch the numpy batch, never an error
+        on the worker thread."""
+        xs, ys = self._gather(start)
+        ff = self.ff
+        try:
+            from ..config import ParallelConfig
+
+            he_keys = {info["input_key"]
+                       for info in getattr(ff, "_host_embed", {}).values()}
+            staged = {}
+            for t, a in xs.items():
+                if f"in_{t.guid}" in he_keys:
+                    staged[t] = a  # set_batch keeps the host copy
+                else:
+                    staged[t] = ff._place_batch(a, ff._input_batch_degree(t))
+            deg = getattr(ff.ops[-1], "pc", ParallelConfig(dims=(1,))).dims[0] \
+                if ff.ops else 1
+            return staged, ff._place_batch(ys, deg)
+        except Exception:
+            return xs, ys
+
     def next_batch(self, ff=None) -> None:
         ff = ff or self.ff
         chaos = getattr(ff, "_chaos", None)
@@ -156,6 +187,6 @@ class DataLoader:
                     max_workers=1, thread_name_prefix="ff-dataloader")
             nxt = self._start_of(self.next_index)
             self._pending = (nxt, self._order_version,
-                             self._pool.submit(self._gather, nxt))
+                             self._pool.submit(self._stage, nxt))
         xs, ys = batch
         ff.set_batch(xs, ys)

@@ -54,6 +54,14 @@ class CostModel:
         self._analytic_memo: Dict[str, float] = {}
         self._measure_failed: set = set()  # don't re-compile known failures
         self.stats = {"measured_hits": 0, "measured_runs": 0, "analytic": 0}
+        # op_time fast path: the string _key is canonical but costs more
+        # to BUILD than a memoized lookup saves, so hot callers (the
+        # delta simulator re-costing thousands of proposals) hit this
+        # (id(op), pc, which) -> (time, stats counter) cache instead.
+        # The op objects are pinned in _fast_ops so a freed op's id can
+        # never alias a live one.
+        self._fast: Dict[tuple, tuple] = {}
+        self._fast_ops: Dict[int, object] = {}
         # Packaged calibrated cache first, local cache second (so a fresh
         # recalibration on this machine overrides the shipped numbers).
         for path in (measured_cache_path or MEASURED_CACHE, cache_path):
@@ -295,21 +303,38 @@ class CostModel:
 
     # -- public ------------------------------------------------------------
     def op_time(self, op, pc, which: str) -> float:
+        fk = (id(op), pc, which)
+        hit = self._fast.get(fk)
+        if hit is not None:
+            t, stat = hit
+            if stat is not None:
+                # keep the counters telling the truth: a fast-path hit
+                # bumps the same counter the slow path would have
+                self.stats[stat] += 1
+            return t
+        t, stat = self._op_time_slow(op, pc, which)
+        self._fast[fk] = (t, stat)
+        self._fast_ops[id(op)] = op
+        return t
+
+    def _op_time_slow(self, op, pc, which: str):
+        """Returns (time, stats counter a repeat call would bump)."""
         if pc is not None and pc.host_placed and op._type == "Embedding":
-            return self._host_embedding_time(op, which)
+            return self._host_embedding_time(op, which), None
         key = self._key(op, pc, which)
         if key in self._measured:
             self.stats["measured_hits"] += 1
-            return self._measured[key]
+            return self._measured[key], "measured_hits"
         if self.measure and key not in self._measure_failed:
             t = self._measure_real(op, pc, which)
             if t is not None:
                 self.stats["measured_runs"] += 1
                 self._measured[key] = t
                 self._persist(key, t)
-                return t
+                # a repeat call would find it in _measured
+                return t, "measured_hits"
             self._measure_failed.add(key)
         self.stats["analytic"] += 1
         if key not in self._analytic_memo:
             self._analytic_memo[key] = self._analytic(op, pc, which)
-        return self._analytic_memo[key]
+        return self._analytic_memo[key], "analytic"

@@ -306,26 +306,31 @@ def native_mcmc_search(model, budget: int, alpha: float = 0.05,
         _ptr(a_choice_init, ctypes.c_int32),
         _ptr(a_choice_out, ctypes.c_int32), ctypes.byref(dp_rt))
 
+    anneal_dt = _time.perf_counter() - anneal_t0
+    proposals_per_s = budget / anneal_dt if anneal_dt > 0 else 0.0
+
     from .search import SearchResult
 
     best = SearchResult({op.name: cand_lists[i][int(a_choice_out[i])]
                          for i, op in enumerate(ops)},
                         engine="native", budget=budget, seed=seed,
                         num_devices=nd, best_s=float(best_rt),
-                        dp_s=float(dp_rt.value))
+                        dp_s=float(dp_rt.value),
+                        proposals_per_s=proposals_per_s)
     if tel is not None:
         # the C engine owns the loop, so the span covers the whole anneal
         # and the end event carries its summary numbers
-        tel.span_at("native_search", anneal_t0,
-                    _time.perf_counter() - anneal_t0,
+        tel.span_at("native_search", anneal_t0, anneal_dt,
                     budget=budget, candidates=int(cand_off[-1]),
                     dp_ms=round(dp_rt.value * 1e3, 3),
-                    best_ms=round(float(best_rt) * 1e3, 3))
+                    best_ms=round(float(best_rt) * 1e3, 3),
+                    proposals_per_s=round(proposals_per_s, 1))
         if rec is not None:
             # per-op final configs (no candidate stream — the loop runs
             # in C), so search_report's "why" table still covers every op
             rec.finish(best, best_ms=float(best_rt) * 1e3,
-                       initial_ms=float(dp_rt.value) * 1e3)
+                       initial_ms=float(dp_rt.value) * 1e3,
+                       proposals_per_s=proposals_per_s)
         tel.flush()
     if verbose:
         print(f"native search: dp {dp_rt.value * 1e3:.3f} ms/iter -> "

@@ -298,7 +298,7 @@ def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
     return best
 
 
-def suggest_parallelization(model, budget: int = 2000,
+def suggest_parallelization(model, budget: Optional[int] = None,
                             machine_model: Optional[TPUMachineModel] = None,
                             seed: int = 0,
                             microbatches: Optional[int] = None) -> Dict:
@@ -309,10 +309,13 @@ def suggest_parallelization(model, budget: int = 2000,
          "strategies": {...} | "pipeline": {...},
          "alternatives": {"dims_s": t1, "pipeline_s": t2}}
     """
+    from ..config import DEFAULT_SEARCH_BUDGET
     from .native_search import native_mcmc_search
     from .search import mcmc_search
     from .simulator import Simulator
 
+    if budget is None:
+        budget = DEFAULT_SEARCH_BUDGET
     nd = model.machine.num_devices if model.machine is not None \
         else model.config.num_devices
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
@@ -326,8 +329,10 @@ def suggest_parallelization(model, budget: int = 2000,
     if r is not None:
         best_dims = r[0]
     if best_dims is None:
+        # share this function's CostModel so the anneal reuses the memo
+        # caches the pipeline grid pass is about to warm (and vice versa)
         best_dims = mcmc_search(model, budget=budget, machine_model=mm,
-                                seed=seed, verbose=False)
+                                seed=seed, verbose=False, cost_model=cost)
     # both engines report the simulated cost of the plan they return —
     # re-simulate only for a caller-supplied plain dict
     dims_t = getattr(best_dims, "best_s", None)

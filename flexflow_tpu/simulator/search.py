@@ -17,9 +17,12 @@ channel split, softmax.cu).
 
 from __future__ import annotations
 
+import functools
 import math
+import os
 import random
-from typing import Dict, List, Optional
+import time
+from typing import Dict, Optional, Tuple
 
 from ..config import DeviceType, ParallelConfig
 from .cost_model import CostModel
@@ -27,8 +30,9 @@ from .machine import TPUMachineModel
 from .simulator import Simulator
 
 
-def _divisors(n: int) -> List[int]:
-    return [d for d in range(1, n + 1) if n % d == 0]
+@functools.lru_cache(maxsize=None)
+def _divisors(n: int) -> Tuple[int, ...]:
+    return tuple(d for d in range(1, n + 1) if n % d == 0)
 
 
 # Per-op-type partitionable dims (natural order, batch first / NHWC).
@@ -58,8 +62,12 @@ _SPLITTABLE = {
 
 def splittable_dims(op) -> tuple:
     """Resolve _SPLITTABLE for this op's actual output rank."""
-    rank = op.output.num_dims
-    dims = _SPLITTABLE.get(op._type, (0,))
+    return _splittable_dims_cached(op._type, op.output.num_dims)
+
+
+@functools.lru_cache(maxsize=None)
+def _splittable_dims_cached(op_type: str, rank: int) -> tuple:
+    dims = _SPLITTABLE.get(op_type, (0,))
     out = []
     for d in dims:
         d = rank - 1 if d == "last" else d
@@ -117,7 +125,9 @@ class SearchResult(Dict[str, ParallelConfig]):
     def __init__(self, strategies: Dict[str, ParallelConfig],
                  engine: str = "", budget: int = 0, seed: int = 0,
                  num_devices: int = 0, best_s: Optional[float] = None,
-                 dp_s: Optional[float] = None):
+                 dp_s: Optional[float] = None,
+                 proposals_per_s: Optional[float] = None,
+                 delta_sim: Optional[bool] = None):
         super().__init__(strategies)
         self.engine = engine
         self.budget = budget
@@ -125,15 +135,40 @@ class SearchResult(Dict[str, ParallelConfig]):
         self.num_devices = num_devices
         self.best_s = best_s
         self.dp_s = dp_s
+        # throughput telemetry only — never part of result equality
+        self.proposals_per_s = proposals_per_s
+        self.delta_sim = delta_sim
+
+
+def _delta_enabled() -> bool:
+    return os.environ.get("FF_SIM_DELTA", "1").lower() \
+        not in ("0", "false", "off")
 
 
 def mcmc_search(model, budget: int, alpha: float = 0.05,
                 machine_model: Optional[TPUMachineModel] = None,
                 measure: bool = False, seed: int = 0,
                 overlap_backward_update: Optional[bool] = None,
-                verbose: bool = True) -> "SearchResult":
+                verbose: bool = True,
+                cost_model: Optional[CostModel] = None) -> "SearchResult":
     """Returns the best strategy map found (op name → ParallelConfig),
-    as a ``SearchResult`` carrying the simulated best cost."""
+    as a ``SearchResult`` carrying the simulated best cost.
+
+    Proposals are re-costed incrementally through ``DeltaSimulator``
+    (fragment caches keyed on per-op configs) — set ``FF_SIM_DELTA=0``
+    to force the full-rebuild reference path.  The RNG stream and accept
+    semantics are identical either way: a seeded search returns the same
+    SearchResult bit for bit, delta on or off (pinned by
+    tests/test_delta_sim.py).  Every ``FF_SIM_DELTA_CHECK`` accepts
+    (default 200) the delta cost is cross-checked against a full rebuild;
+    a divergence emits a ``sim_delta_divergence`` event and drops to the
+    reference path for the rest of the run.
+
+    ``cost_model`` lets a caller that already owns a warmed CostModel
+    (pipeline_search's grid pass) share its memo caches with the anneal;
+    only honored when its configuration matches what this function would
+    build (measure=False path).
+    """
     nd = model.machine.num_devices if model.machine is not None \
         else model.config.num_devices
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
@@ -145,16 +180,28 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
     import jax
 
     platform = jax.default_backend() if measure else "tpu"
-    sim = Simulator(mm, CostModel(mm, measure=measure,
-                                  compute_dtype=model.config.compute_dtype,
-                                  target_platform=platform),
-                    overlap_backward_update=overlap)
+    cost = cost_model if (cost_model is not None and not measure
+                          and cost_model.machine is mm) else \
+        CostModel(mm, measure=measure,
+                  compute_dtype=model.config.compute_dtype,
+                  target_platform=platform)
+    sim = Simulator(mm, cost, overlap_backward_update=overlap)
     rng = random.Random(seed)
+
+    delta = None
+    if _delta_enabled():
+        try:
+            from .delta import DeltaSimulator
+            delta = DeltaSimulator(sim, model)
+        except Exception:
+            delta = None  # any construction failure -> reference path
+    check_every = int(os.environ.get("FF_SIM_DELTA_CHECK", "200") or 0)
 
     current = {op.name: ParallelConfig.data_parallel(op.output.num_dims, nd)
                .with_device_ids(tuple(range(nd)))
                for op in model.ops}
-    current_rt = sim.simulate_runtime(model, current)
+    current_rt = delta.reset(current) if delta is not None \
+        else sim.simulate_runtime(model, current)
     best, best_rt = dict(current), current_rt
     dp_rt = current_rt
 
@@ -168,18 +215,26 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
         rec.start(initial_ms=dp_rt * 1e3)
     span = tel.span("mcmc_search", budget=budget, num_devices=nd) \
         if tel is not None else contextlib.nullcontext({})
+    accepts = 0
+    anneal_t0 = time.perf_counter()
     with span as span_attrs:
         for it in range(budget):
             op = rng.choice(model.ops)
             old_pc = current[op.name]
-            nxt = dict(current)
             # Legalize through the op hook so configs whose dims carry
             # non-size meaning (PipelineMLP pipe degree) are clamped
             # against the real bound before costing (same as the native
             # engine path).
-            nxt[op.name] = op.legalize_pc(
+            new_pc = op.legalize_pc(
                 random_parallel_config(op, nd, rng, model=model))
-            nxt_rt = sim.simulate_runtime(model, nxt)
+            if delta is not None:
+                nxt_rt = delta.propose(op.name, new_pc)
+            else:
+                # reference path: mutate-in-place + restore beats the old
+                # per-proposal dict(current) copy; same simulated graph
+                current[op.name] = new_pc
+                nxt_rt = sim.simulate_runtime(model, current)
+                current[op.name] = old_pc
             if it % 100 == 0:
                 if verbose:
                     print(f"iter({it}) cur({current_rt * 1e3:.3f}ms) "
@@ -189,7 +244,9 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
                     tel.event("search_progress", engine="mcmc", iter=it,
                               best_ms=round(best_rt * 1e3, 3))
             if nxt_rt < best_rt:
-                best_rt, best = nxt_rt, dict(nxt)
+                best_rt = nxt_rt
+                best = dict(current)
+                best[op.name] = new_pc
             # Accept semantics unchanged from the reference (downhill
             # always; uphill with Metropolis probability) — spelled out
             # so the recorder can carry the reason + probability.  The
@@ -202,15 +259,43 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
                 prob = math.exp(-alpha * (nxt_rt - current_rt) * 1e3)
                 accepted, reason = rng.random() < prob, "metropolis"
             if rec is not None:
-                rec.candidate(it, op.name, old_pc, nxt[op.name],
+                rec.candidate(it, op.name, old_pc, new_pc,
                               cur_ms=current_rt * 1e3, new_ms=nxt_rt * 1e3,
                               best_ms=best_rt * 1e3, accepted=accepted,
                               reason=reason, prob=prob)
             if accepted:
-                current, current_rt = nxt, nxt_rt
+                current[op.name] = new_pc
+                current_rt = nxt_rt
+                if delta is not None:
+                    delta.commit()
+                    accepts += 1
+                    if check_every and accepts % check_every == 0:
+                        # periodic oracle cross-check: the delta cost of
+                        # the committed plan must match a full rebuild
+                        full_rt = sim.simulate_runtime(model, current)
+                        tol = 1e-9 * max(abs(full_rt), abs(current_rt), 1e-30)
+                        if abs(full_rt - current_rt) > tol:
+                            import sys as _sys
+                            print("WARNING: delta simulation diverged "
+                                  f"({current_rt!r} vs {full_rt!r}); "
+                                  "falling back to full re-simulation",
+                                  file=_sys.stderr)
+                            if tel is not None:
+                                tel.event("sim_delta_divergence",
+                                          engine="mcmc", iter=it,
+                                          delta_s=current_rt, full_s=full_rt)
+                            delta = None
+                            current_rt = full_rt
+            elif delta is not None:
+                delta.rollback()
         span_attrs["best_ms"] = round(best_rt * 1e3, 3)
+        anneal_dt = time.perf_counter() - anneal_t0
+        proposals_per_s = budget / anneal_dt if anneal_dt > 0 else 0.0
+        span_attrs["proposals_per_s"] = round(proposals_per_s, 1)
     if rec is not None:
-        rec.finish(best, best_ms=best_rt * 1e3)
+        rec.finish(best, best_ms=best_rt * 1e3,
+                   proposals_per_s=proposals_per_s,
+                   delta=delta is not None)
     if tel is not None:
         tel.flush()
     if verbose:
@@ -219,4 +304,6 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
             print(f"[{name}] dims{list(pc.dims)} parts({pc.num_parts()})")
         print(f"simulated runtime: {best_rt * 1e3:.3f} ms/iter")
     return SearchResult(best, engine="mcmc", budget=budget, seed=seed,
-                        num_devices=nd, best_s=best_rt, dp_s=dp_rt)
+                        num_devices=nd, best_s=best_rt, dp_s=dp_rt,
+                        proposals_per_s=proposals_per_s,
+                        delta_sim=delta is not None)

@@ -70,8 +70,12 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--hbm-bw", type=float, default=None)
     p.add_argument("--compute-dtype", default="bfloat16",
                    help="dtype the cost model keys on (the bench dtype)")
-    p.add_argument("--budget", type=int, default=1000,
-                   help="MCMC iterations (reference default search budget)")
+    from ..config import DEFAULT_SEARCH_BUDGET
+
+    p.add_argument("--budget", type=int, default=DEFAULT_SEARCH_BUDGET,
+                   help="MCMC iterations (default sized for the delta "
+                        "simulator; FF_SIM_DELTA=0 restores the full "
+                        "rebuild per proposal)")
     p.add_argument("--alpha", type=float, default=0.05)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--export", default=None, help="strategy .pb output path")

@@ -133,9 +133,13 @@ def _is_bench(e: Dict) -> bool:
 
 
 def measured_ok(e: Dict) -> bool:
-    """A real (non-proxy) chip measurement that completed with a value."""
+    """A real (non-proxy) chip measurement that completed with a value.
+    Backend-gated to "tpu": host-side metrics like search_throughput are
+    real (proxy: false) but must never become doctor/bench's cached
+    "last good chip number"."""
     return (_is_bench(e) and e.get("status") == "ok"
-            and not e.get("proxy") and (e.get("value") or 0) > 0)
+            and not e.get("proxy") and (e.get("value") or 0) > 0
+            and e.get("backend", "tpu") == "tpu")
 
 
 def last_good(entries: Optional[List[Dict]] = None,

@@ -1,0 +1,126 @@
+"""Search-throughput benchmark: delta simulation vs full rebuild.
+
+Runs the same seeded ``mcmc_search`` twice — FF_SIM_DELTA=1 then
+FF_SIM_DELTA=0 — asserts the two SearchResults are IDENTICAL (strategy
+map, best_s, dp_s: the delta simulator's bitwise-equality contract),
+prints a JSON line with both proposals/sec numbers and their ratio, and
+appends a ``search_throughput`` entry to PERF_LEDGER.jsonl so
+tools/perf_ledger.py regression detection covers search speed the same
+way it covers training throughput.  The ledger entry is stamped
+``backend: "cpu"`` (search throughput is a host metric — it must never
+read as the cached last-good CHIP number) with ``proxy: false`` (it is a
+real measurement of the thing it names).
+
+    python -m flexflow_tpu.tools.search_bench alexnet --devices 16 \
+        --budget 1000 --seed 0
+
+Exit code 1 if the two runs disagree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _run_search(model_name: str, batch_size: int, devices: int,
+                budget: int, seed: int, delta: bool):
+    from ..simulator.machine import TPUMachineModel
+    from ..simulator.search import mcmc_search
+    from .offline_search import build_model
+
+    os.environ["FF_SIM_DELTA"] = "1" if delta else "0"
+    try:
+        # a fresh model per run: op ids must not leak between the two
+        # engines' caches, and graph construction is not what we time
+        model = build_model(model_name, batch_size, devices)
+        mm = TPUMachineModel.calibrated(num_devices=devices)
+        return mcmc_search(model, budget=budget, machine_model=mm,
+                           seed=seed, verbose=False)
+    finally:
+        del os.environ["FF_SIM_DELTA"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("model", nargs="?", default="alexnet",
+                   help="model zoo name (see offline_search)")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--devices", type=int, default=16)
+    p.add_argument("--budget", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="time each engine this many times, report the "
+                        "fastest (results must agree across repeats)")
+    p.add_argument("--ledger", default=None,
+                   help="perf-ledger path (default: repo PERF_LEDGER.jsonl)")
+    p.add_argument("--no-ledger", action="store_true",
+                   help="measure + compare only, append nothing")
+    args = p.parse_args(argv)
+
+    # best-of-N timing on each engine: the searches are deterministic
+    # (every repeat must return the same result — checked below), so max
+    # throughput is the measurement least polluted by scheduler noise on
+    # a shared host.
+    runs_a = [_run_search(args.model, args.batch_size, args.devices,
+                          args.budget, args.seed, delta=True)
+              for _ in range(args.repeats)]
+    runs_b = [_run_search(args.model, args.batch_size, args.devices,
+                          args.budget, args.seed, delta=False)
+              for _ in range(args.repeats)]
+    a = max(runs_a, key=lambda r: r.proposals_per_s)
+    b = max(runs_b, key=lambda r: r.proposals_per_s)
+
+    identical = all(dict(r) == dict(a) and r.best_s == a.best_s
+                    and r.dp_s == a.dp_s for r in runs_a + runs_b)
+    ratio = (a.proposals_per_s / b.proposals_per_s
+             if b.proposals_per_s else 0.0)
+    out = {
+        "metric": "search_throughput",
+        "model": args.model,
+        "devices": args.devices,
+        "budget": args.budget,
+        "seed": args.seed,
+        "repeats": args.repeats,
+        "identical": identical,
+        "delta_proposals_per_s": round(a.proposals_per_s, 1),
+        "full_proposals_per_s": round(b.proposals_per_s, 1),
+        "ratio": round(ratio, 1),
+        "best_ms": round((a.best_s or 0.0) * 1e3, 3),
+    }
+    print(json.dumps(out))
+    if not identical:
+        diff = [k for k in set(a) | set(b) if a.get(k) != b.get(k)]
+        print(f"search_bench: MISMATCH delta vs full "
+              f"(best_s {a.best_s!r} vs {b.best_s!r}; ops {sorted(diff)})",
+              file=sys.stderr)
+        return 1
+    if not args.no_ledger:
+        from . import perf_ledger
+
+        perf_ledger.append_entry({
+            "kind": "bench",
+            "metric": "search_throughput",
+            "value": round(a.proposals_per_s, 1),
+            "unit": "proposals/s",
+            "backend": "cpu",
+            "proxy": False,
+            "status": "ok",
+            "batch": args.batch_size,
+            "provenance": {
+                "model": args.model,
+                "devices": args.devices,
+                "budget": args.budget,
+                "seed": args.seed,
+                "full_proposals_per_s": round(b.proposals_per_s, 1),
+                "ratio": round(ratio, 1),
+            },
+        }, path=args.ledger)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -234,6 +234,13 @@ def _render_engine(engine: str, events: Dict[str, List[Dict[str, Any]]],
         acc = summ.get("accepted", 0)
         lines.append(f"- proposals {proposals} · accepted {acc} "
                      f"({100.0 * acc / proposals:.0f}%)")
+    pps = summ.get("proposals_per_s")
+    if pps:
+        sim_kind = ""
+        if "delta" in summ:
+            sim_kind = (" (delta simulation)" if summ["delta"]
+                        else " (full re-simulation)")
+        lines.append(f"- throughput {pps:g} proposals/s{sim_kind}")
     lines.append("")
 
     # -- convergence ----------------------------------------------------

@@ -98,6 +98,24 @@ python -m flexflow_tpu.tools.search_report \
   || { echo "search smoke: strategy diff failed"; exit 1; }
 echo "search smoke: OK ($(wc -l < "$STRACE") trace records)"
 
+# Delta-simulation smoke: the incremental simulator must return the
+# IDENTICAL seeded search result as the full rebuild (search_bench exits
+# 1 on any mismatch) and append a search_throughput entry to the perf
+# ledger (docs/simulator.md "Delta simulation").  Tiny budget: this
+# verifies the equality contract and the ledger plumbing, not the 10x
+# throughput number — that is search_bench's default-budget job.
+DELTA_LEDGER="$SMOKE_DIR/delta_ledger.jsonl"
+DELTA_OUT=$(python -m flexflow_tpu.tools.search_bench alexnet --devices 16 \
+    --budget 200 --seed 0 --repeats 1 --ledger "$DELTA_LEDGER") \
+  || { echo "delta smoke: search_bench failed (delta vs full mismatch?)"; exit 1; }
+grep -q '"metric": "search_throughput"' "$DELTA_LEDGER" \
+  || { echo "delta smoke: no search_throughput ledger entry"; exit 1; }
+echo "delta smoke: OK ($(echo "$DELTA_OUT" | python -c "
+import json, sys
+b = json.loads(sys.stdin.read())
+print(f\"identical={b['identical']}, {b['delta_proposals_per_s']} vs \"
+      f\"{b['full_proposals_per_s']} proposals/s, ratio {b['ratio']}x\")"))"
+
 # Serving smoke: train the toy transformer, serve 8 concurrent HTTP
 # requests through the continuous-batching engine, verify every greedy
 # output bitwise against one-shot generate(), and fold the serving

@@ -1,0 +1,133 @@
+"""DeltaSimulator equality tests.
+
+The delta simulator's contract is BITWISE equality with the full
+rebuild (delta.py module docstring): same strategies in, same float
+out, for every proposal — not "close", identical.  These tests pin
+that over random proposal sequences on several model graphs (including
+host-rowsparse embedding placements and both weight-sync modes), and
+pin that a seeded mcmc_search returns an identical SearchResult with
+FF_SIM_DELTA on and off.
+"""
+
+import random
+
+import pytest
+
+from flexflow_tpu.config import ParallelConfig
+from flexflow_tpu.simulator.cost_model import CostModel
+from flexflow_tpu.simulator.delta import DeltaSimulator
+from flexflow_tpu.simulator.machine import TPUMachineModel
+from flexflow_tpu.simulator.search import mcmc_search, random_parallel_config
+from flexflow_tpu.simulator.simulator import Simulator
+from flexflow_tpu.tools.offline_search import build_model
+
+
+def _setup(name, nd, overlap):
+    model = build_model(name, 64, nd)
+    mm = TPUMachineModel.calibrated(num_devices=nd)
+    sim = Simulator(mm, CostModel(mm, measure=False))
+    sim.overlap = overlap
+    dp = {op.name: ParallelConfig.data_parallel(op.output.num_dims, nd)
+          .with_device_ids(tuple(range(nd)))
+          for op in model.ops}
+    return model, sim, dp
+
+
+def _drive(model, sim, dp, nd, proposals, seed):
+    """Random propose/commit/rollback walk asserting exact equality of
+    every delta cost against a from-scratch simulate_runtime."""
+    delta = DeltaSimulator(sim, model)
+    assert delta.reset(dp) == sim.simulate_runtime(model, dp)
+    cur = dict(dp)
+    rng = random.Random(seed)
+    ops = [op for op in model.ops if op.weights or op.inputs]
+    for _ in range(proposals):
+        op = rng.choice(ops)
+        pc = op.legalize_pc(random_parallel_config(op, nd, rng, model=model))
+        trial = dict(cur)
+        trial[op.name] = pc
+        assert delta.propose(op.name, pc) == sim.simulate_runtime(model, trial)
+        if rng.random() < 0.4:
+            delta.commit()
+            cur = trial
+        else:
+            delta.rollback()
+    # the committed state survived the walk intact
+    assert delta.reset(cur) == sim.simulate_runtime(model, cur)
+
+
+# 5 cases x 45 proposals = 225 random proposals per suite run, plus the
+# dedicated host-rowsparse walk below.
+CASES = [
+    ("alexnet", 16, False),
+    ("alexnet", 16, True),   # overlap_backward_update
+    ("dlrm", 8, False),      # embeddings (host-rowsparse reachable)
+    ("dlrm", 8, True),
+    ("transformer", 8, False),
+]
+
+
+@pytest.mark.parametrize("name,nd,overlap", CASES)
+def test_delta_matches_full_exactly(name, nd, overlap):
+    model, sim, dp = _setup(name, nd, overlap)
+    _drive(model, sim, dp, nd, proposals=45, seed=12345)
+
+
+def test_delta_host_rowsparse_embedding():
+    """Forced host placement: embeddings move to the host (and back),
+    which rewrites node devices, kills comm tasks on incident edges,
+    and drops the update fragment — the deepest single-op rewrite."""
+    model, sim, dp = _setup("dlrm", 8, False)
+    delta = DeltaSimulator(sim, model)
+    delta.reset(dp)
+    embs = [op for op in model.ops if op._type == "Embedding"]
+    assert embs, "dlrm zoo model lost its embeddings"
+    cur = dict(dp)
+    for op in embs:
+        pc = op.legalize_pc(ParallelConfig.host_rowsparse(op.output.num_dims))
+        trial = dict(cur)
+        trial[op.name] = pc
+        assert delta.propose(op.name, pc) == sim.simulate_runtime(model, trial)
+        delta.commit()
+        cur = trial
+    # and back off-host again
+    op = embs[0]
+    pc = op.legalize_pc(ParallelConfig.data_parallel(op.output.num_dims, 8)
+                        .with_device_ids(tuple(range(8))))
+    trial = dict(cur)
+    trial[op.name] = pc
+    assert delta.propose(op.name, pc) == sim.simulate_runtime(model, trial)
+    delta.rollback()
+    assert delta.propose(op.name, pc) == sim.simulate_runtime(model, trial)
+
+
+def test_delta_python_fallback_matches(monkeypatch):
+    """With the native event engine unavailable, the Python heap
+    fallbacks of both engines must still agree exactly."""
+    import flexflow_tpu.utils.native as native
+
+    monkeypatch.setattr(native, "sim_lib", lambda: None)
+    model, sim, dp = _setup("alexnet", 16, False)
+    _drive(model, sim, dp, 16, proposals=12, seed=99)
+
+
+def _search(delta_on, monkeypatch, budget=150, seed=3):
+    monkeypatch.setenv("FF_SIM_DELTA", "1" if delta_on else "0")
+    model = build_model("alexnet", 64, 16)
+    mm = TPUMachineModel.calibrated(num_devices=16)
+    return mcmc_search(model, budget=budget, machine_model=mm,
+                       seed=seed, verbose=False)
+
+
+def test_mcmc_identical_with_delta_on_off(monkeypatch):
+    """Seeded search is bit-for-bit reproducible across engines: same
+    strategy map, same best/dp costs — only the throughput telemetry
+    may differ."""
+    a = _search(True, monkeypatch)
+    b = _search(False, monkeypatch)
+    assert dict(a) == dict(b)
+    assert a.best_s == b.best_s
+    assert a.dp_s == b.dp_s
+    assert a.delta_sim is True
+    assert b.delta_sim is False
+    assert a.proposals_per_s > 0 and b.proposals_per_s > 0
