@@ -28,6 +28,7 @@ update``) is preserved: the calls stage work and the fused step executes at
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
 import time
@@ -121,6 +122,8 @@ class FFModel:
         self._staged = False
         self._train_step_fn = None
         self._eval_step_fn = None
+        self._fresh_jit = False  # next train-step build bypasses the
+        #                          persistent compile cache (recompile)
         self._compiled = False
         self._pipeline_req = None
         self._pipeline_plan = None
@@ -967,6 +970,106 @@ class FFModel:
     def _all_strategies(self) -> Dict[str, ParallelConfig]:
         return {op.name: getattr(op, "pc", ParallelConfig.data_parallel(
             op.output.num_dims, self.machine.num_devices)) for op in self.ops}
+
+    def recompile(self, strategies: Optional[Dict[str, ParallelConfig]] = None,
+                  machine: Optional[Machine] = None) -> None:
+        """Re-parallelize a compiled (and possibly mid-training) model IN
+        PLACE: swap the strategy map and/or the machine, re-resolve
+        per-op configs, rebuild the jitted step, and migrate the live
+        training state onto the new shardings through the same canonical
+        host-side form a cross-mesh checkpoint restore uses.
+
+        This is the hot-swap half of online re-parallelization
+        (runtime/reconfigure.py): the controller drains, saves, calls
+        ``recompile`` with the re-searched strategies (and a shrunken
+        ``Machine(devices=survivors)`` after a device loss), then
+        restores — the restore targets are built from the model's
+        CURRENT shardings, so state re-shards onto the new mesh.
+
+        No search, no import/export: the caller owns strategy selection
+        here.  ``config.strategies`` keeps the applied map so later
+        exports/provenance reflect what is actually running.
+
+        Limitation: pipelined models repack their stage buffer with the
+        PREVIOUS buffer's sharding, so a pipelined swap is only safe
+        while the device set is unchanged (divergence-triggered swaps).
+        """
+        assert self._compiled, "recompile() requires a compiled model"
+        import contextlib
+
+        from .runtime.checkpoint import _tree_from_model, place_state
+
+        # Snapshot live state in the canonical layout-portable form
+        # (host numpy) BEFORE the mesh/shardings change underneath it.
+        state = None
+        if self._params is not None:
+            state = jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a))
+                if hasattr(a, "shape") else a, _tree_from_model(self))
+
+        cfg = self.config
+        saved = (cfg.search_budget, cfg.import_strategy_file,
+                 cfg.export_strategy_file)
+        cfg.search_budget = 0
+        cfg.import_strategy_file = None
+        cfg.export_strategy_file = None
+        if strategies is not None:
+            cfg.strategies.update(strategies)
+        tel = self._telemetry
+        span = tel.span("recompile", num_ops=len(self.ops)) \
+            if tel is not None else contextlib.nullcontext({})
+        try:
+            with span as at:
+                self._compile_impl(
+                    self.optimizer, self.loss.loss_type,
+                    list(self.metrics.metrics),
+                    machine=machine if machine is not None else self.machine)
+                if at is not None:
+                    at["num_devices"] = self.machine.num_devices
+        finally:
+            (cfg.search_budget, cfg.import_strategy_file,
+             cfg.export_strategy_file) = saved
+        # The swapped-in step function must be compiled fresh, never
+        # deserialized from the persistent cache (_bypass_compile_cache).
+        self._fresh_jit = True
+
+        # Re-run the optimizer-wiring half of init_layers: mesh, per-leaf
+        # specs, and the ZeRO layout all follow the new machine — a stale
+        # mesh here would shard-map updates over devices that are gone.
+        if self.optimizer is not None and state is not None:
+            shardings = self._param_spec_tree()
+            specs = {opn: {wn: sh.spec for wn, sh in ws.items()}
+                     for opn, ws in shardings.items()}
+            multi = self.machine.num_devices > 1
+            nonfused = set(self._offload)
+            nonfused |= {(opn, info["weight"])
+                         for opn, info in self._host_embed.items()}
+            zero_specs = (self._zero_state_specs()
+                          if cfg.zero_optimizer and multi else None)
+            if zero_specs:
+                nonfused |= set(zero_specs)
+            self.optimizer.set_mesh(self.machine.mesh if multi else None,
+                                    specs, nonfused_paths=nonfused)
+            self.optimizer.zero_specs = zero_specs
+
+        if state is not None:
+            place_state(self, state)
+        # Device-resident caches keyed on the old mesh: the staged batch
+        # is re-placed by the next set_batch; metric accumulation is
+        # re-hosted (uncommitted) so the new step function may place it.
+        self._batch = None
+        if self._metric_acc is not None:
+            self._metric_acc = jnp.asarray(
+                np.asarray(jax.device_get(self._metric_acc)))
+        self._dp_cache = None
+        self._he_dev_cache = None
+
+        if tel is not None:
+            from .observability import agreement as _ff_agreement
+
+            # post-swap divergence must compare against the NEW strategy
+            _ff_agreement.emit_compile_prediction(self, tel)
+            tel.flush()
 
     def _export_provenance(self) -> Optional[Dict[str, Any]]:
         """Provenance sidecar payload for an exported strategy: which
@@ -1973,10 +2076,32 @@ class FFModel:
             return self._stepstats.timed_update(self._update_impl)
         self._update_impl()
 
+    @staticmethod
+    @contextlib.contextmanager
+    def _bypass_compile_cache():
+        """The persistent compilation cache and a mid-training re-compile
+        don't mix: an executable deserialized from the on-disk cache can
+        mis-alias donated buffers when it replaces a live step function
+        (intermittent NaN params / heap corruption on the CPU backend),
+        and a crash mid-write leaves a truncated entry that kills every
+        later swap.  Hot-swap rebuilds compile fresh instead — the cache
+        stays on for cold-start compiles, where it is safe and earns its
+        keep."""
+        old = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_enable_compilation_cache", old)
+
     def _update_impl(self) -> None:
         assert self._batch is not None, "no batch loaded: call a DataLoader first"
+        compile_ctx = contextlib.nullcontext()
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
+            if self._fresh_jit:
+                compile_ctx = self._bypass_compile_cache()
+                self._fresh_jit = False
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()
         if self._metric_acc is None:
@@ -1998,10 +2123,11 @@ class FFModel:
         if self._host_embed:
             params_in, opt_in, batch_in, he_ctxs = \
                 self._host_embed_swap_in(params_in, opt_in, self._batch)
-        new_params, self._stats, new_opt, self._metric_acc = \
-            self._train_step_fn(params_in, self._stats, opt_in,
-                                hp, batch_in, jnp.uint32(self._step_count),
-                                self._metric_acc)
+        with compile_ctx:  # first call traces+compiles; later calls no-op
+            new_params, self._stats, new_opt, self._metric_acc = \
+                self._train_step_fn(params_in, self._stats, opt_in,
+                                    hp, batch_in, jnp.uint32(self._step_count),
+                                    self._metric_acc)
         if he_ctxs:
             new_params, new_opt = self._host_embed_scatter_back(
                 new_params, new_opt, he_ctxs)

@@ -204,6 +204,23 @@ def strategy_content_hash(data: bytes) -> str:
     return "sha256:" + hashlib.sha256(data).hexdigest()
 
 
+def strategies_fingerprint(strategies: Dict[str, ParallelConfig]) -> str:
+    """Content hash of a strategy MAP, independent of insertion order:
+    ops serialized sorted-by-name with the same wire framing
+    ``save_strategies_to_file`` uses, so two maps fingerprint equal iff
+    they would round-trip to the same canonical ``.pb`` bytes.  Recorded
+    in ``resume_meta.json`` (elastic_train) so a checkpoint remembers
+    which parallelization it was taken under — the resume-after-
+    reconfigure check keys on this."""
+    buf = io.BytesIO()
+    for name in sorted(strategies):
+        body = _encode_op(name, strategies[name])
+        _write_tag(buf, 1, _WIRE_LEN)
+        _write_varint(buf, len(body))
+        buf.write(body)
+    return strategy_content_hash(buf.getvalue())
+
+
 def write_provenance(filename: str, meta: Dict[str, Any]) -> str:
     """Stamp ``<filename>.meta.json``: the caller's metadata (engine,
     budget, seed, costs, per-op attribution — see

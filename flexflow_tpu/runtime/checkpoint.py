@@ -331,7 +331,15 @@ def _load_npz(model, path: str) -> None:
         return data[prefix[:-1]]
 
     state = rebuild(_tree_from_model(model))
-    # Re-place arrays with the model's shardings.
+    place_state(model, state)
+
+
+def place_state(model, state: Dict[str, Any]) -> None:
+    """Re-place a canonical (host-side, layout-portable) state tree with
+    the model's CURRENT shardings and apply it.  Shared by the ``.npz``
+    restore path and ``FFModel.recompile`` — after a strategy hot-swap
+    the live training state must move onto the new mesh/sharding layout
+    exactly the way a cross-mesh restore would."""
     spec_tree = model._param_spec_tree()
 
     he = getattr(model, "_host_embed", {})

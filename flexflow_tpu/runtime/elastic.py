@@ -32,13 +32,15 @@ long-running training needs:
 from __future__ import annotations
 
 import itertools
+import sys
 import threading
 import warnings
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Set, Tuple
 
 from .checkpoint import CheckpointManager
 from .resilience import (Preempted, PreemptionHandler, ResumeMismatchError,
-                         read_resume_meta, write_resume_meta)
+                         StrategyMismatchError, read_resume_meta,
+                         write_resume_meta)
 
 
 class DeviceHangError(RuntimeError):
@@ -55,15 +57,22 @@ class StepWatchdog:
 
     Each timed call runs on a fresh named daemon thread
     (``ff-watchdog-N``) so a stranded worker is identifiable in a
-    thread dump.  A hang emits a ``device_hang`` telemetry event before
-    raising; stranded workers accumulate in a class-level list (they
-    cannot be cancelled, only abandoned) and repeated hangs warn once
-    the pile grows — each one pins a blocked device call forever.
+    thread dump.  A hang emits a ``device_hang`` telemetry event and a
+    ``stranded_count`` gauge before raising; stranded workers accumulate
+    in a class-level list (they cannot be cancelled, only abandoned),
+    capped at ``STRANDED_MAX`` references — the threads themselves
+    cannot be reclaimed, but the bookkeeping must not grow without
+    bound across thousands of hangs.  Once the pile crosses
+    ``STRANDED_WARN_AT`` each distinct CALL SITE warns once — a second
+    subsystem hitting the same wedged device gets its own warning
+    instead of silence because some earlier site already warned.
     """
 
     STRANDED_WARN_AT = 3
+    STRANDED_MAX = 32
 
     _stranded: List[threading.Thread] = []  # class-level, across instances
+    _warned_sites: Set[Tuple[str, int]] = set()
     _seq = itertools.count(1)
 
     def __init__(self, timeout: float):
@@ -88,18 +97,25 @@ class StepWatchdog:
             cls = type(self)
             cls._stranded[:] = [w for w in cls._stranded if w.is_alive()]
             cls._stranded.append(t)
+            del cls._stranded[:-cls.STRANDED_MAX]  # cap the bookkeeping
             from ..observability import events
 
             log = events.active_log()
             if log is not None:
                 log.event("device_hang", timeout_s=self.timeout,
                           thread=name, stranded=len(cls._stranded))
+                log.gauge("stranded_count", len(cls._stranded))
                 log.flush()
-            if len(cls._stranded) >= self.STRANDED_WARN_AT:
+            caller = sys._getframe(1)
+            site = (caller.f_code.co_filename, caller.f_lineno)
+            if len(cls._stranded) >= self.STRANDED_WARN_AT \
+                    and site not in cls._warned_sites:
+                cls._warned_sites.add(site)
                 warnings.warn(
                     f"StepWatchdog: {len(cls._stranded)} worker threads "
                     "stranded on hung device calls — each pins a blocked "
-                    "native call forever; restart the process",
+                    "native call forever; restart the process "
+                    f"(called from {site[0]}:{site[1]})",
                     RuntimeWarning)
             raise DeviceHangError(
                 f"device unresponsive for {self.timeout:.0f}s "
@@ -132,7 +148,8 @@ def elastic_train(model, dataloader, epochs: int,
                   save_on_failure: bool = True,
                   save_every_steps: Optional[int] = None,
                   handle_preemption: bool = True,
-                  on_steps_mismatch: str = "error") -> int:
+                  on_steps_mismatch: str = "error",
+                  on_strategy_mismatch: str = "error") -> int:
     """Run (or resume) an epoch training loop with checkpoint rotation.
 
     Returns the number of epochs actually executed in THIS invocation.
@@ -153,10 +170,29 @@ def elastic_train(model, dataloader, epochs: int,
     then well-defined but not bitwise-comparable to the original
     schedule).  SIGTERM/SIGINT trigger a force-save + clean exit via
     ``resilience.Preempted`` unless ``handle_preemption=False``.
+
+    ``resume_meta.json`` also records the content hash of the ACTIVE
+    strategy map, so resume-after-reconfigure is explicit:
+    ``on_strategy_mismatch`` governs a resume whose compiled strategies
+    differ from the checkpointed run's — ``"error"`` raises
+    ``StrategyMismatchError`` naming both hashes (and the swap ``.pb``
+    the reconfiguration controller recorded, when one exists);
+    ``"recompute"`` warns and continues on the compiled strategies (the
+    restore itself is layout-portable either way).
+
+    When ``FF_RECONFIGURE`` is set, the loop owns a
+    ``reconfigure.ReconfigurationController`` (online re-parallelization
+    — docs/robustness.md) and gives it a step-boundary hook after every
+    ``train_iteration``; unset costs one ``is not None`` test per step.
     """
     if on_steps_mismatch not in ("error", "recompute"):
         raise ValueError(f"on_steps_mismatch={on_steps_mismatch!r}: "
                          "expected 'error' or 'recompute'")
+    if on_strategy_mismatch not in ("error", "recompute"):
+        raise ValueError(f"on_strategy_mismatch={on_strategy_mismatch!r}: "
+                         "expected 'error' or 'recompute'")
+    from ..parallel.strategy import strategies_fingerprint
+
     mgr = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
     wd = StepWatchdog(step_timeout) if step_timeout else None
     sync = (lambda: wd.run(model.sync)) if wd else model.sync
@@ -164,6 +200,27 @@ def elastic_train(model, dataloader, epochs: int,
     restored = mgr.restore_latest(model)
     if restored is not None:
         meta = read_resume_meta(checkpoint_dir)
+        saved_hash = (meta or {}).get("strategy_hash")
+        cur_hash = strategies_fingerprint(model._all_strategies()) \
+            if saved_hash else None
+        if saved_hash and saved_hash != cur_hash:
+            hint = (meta or {}).get("strategy_file")
+            hint = f" (the active strategy was recorded at {hint!r})" \
+                if hint else ""
+            if on_strategy_mismatch == "error":
+                raise StrategyMismatchError(
+                    f"checkpoint in {checkpoint_dir!r} was taken under "
+                    f"strategy {saved_hash} but the model compiled "
+                    f"{cur_hash}{hint} — a mid-run reconfiguration (or a "
+                    "changed import/search) moved the parallelization.  "
+                    "Re-compile with the recorded strategy file, or pass "
+                    "on_strategy_mismatch='recompute' to continue on the "
+                    "compiled strategies (the restore is layout-portable; "
+                    "step timing is not comparable)")
+            warnings.warn(
+                f"elastic_train: strategy changed {saved_hash} -> "
+                f"{cur_hash}{hint}; continuing on the compiled "
+                "strategies", RuntimeWarning)
         saved_spe = (meta or {}).get("steps_per_epoch")
         if saved_spe is not None and int(saved_spe) != steps_per_epoch:
             if on_steps_mismatch == "error":
@@ -186,12 +243,22 @@ def elastic_train(model, dataloader, epochs: int,
 
     def _save(step: int, force: bool = False) -> None:
         step = int(step)
-        if not force and mgr.latest_step() == step:
-            return  # this step is already on disk
+        if mgr.latest_step() == step:
+            # Already on disk — params only move with the step count, so
+            # a second save of the same step is the same state.  Applies
+            # to force too: a SIGTERM landing right after an epoch-end
+            # save would otherwise re-save the step and trip orbax's
+            # StepAlreadyExistsError inside the preemption handler.
+            return
         mgr.save(model, step=step, force=force)
-        write_resume_meta(checkpoint_dir, step=step,
-                          steps_per_epoch=steps_per_epoch,
-                          epochs_target=int(epochs))
+        # the strategy hash follows the LIVE strategies, so a post-swap
+        # save records the reconfigured map automatically
+        write_resume_meta(
+            checkpoint_dir, step=step,
+            steps_per_epoch=steps_per_epoch,
+            epochs_target=int(epochs),
+            strategy_hash=strategies_fingerprint(model._all_strategies()),
+            strategy_file=getattr(model, "_active_strategy_file", None))
 
     def _preempt_save(pre) -> None:
         from ..observability.health import write_heartbeat
@@ -207,6 +274,10 @@ def elastic_train(model, dataloader, epochs: int,
         write_heartbeat("preempted", step=step)
         raise Preempted(step)
 
+    from .reconfigure import maybe_controller
+
+    ctrl = maybe_controller(model, mgr, checkpoint_dir,
+                            save_fn=_save, sync_fn=sync)
     ran = 0
     pre_cm = PreemptionHandler() if handle_preemption else _NoPreemption()
     try:
@@ -232,6 +303,8 @@ def elastic_train(model, dataloader, epochs: int,
                         _preempt_save(pre)
                     dataloader.next_batch(model)
                     model.train_iteration()
+                    if ctrl is not None:
+                        ctrl.on_step()
                     if save_every_steps and \
                             model._step_count % save_every_steps == 0:
                         sync()
@@ -264,5 +337,7 @@ def elastic_train(model, dataloader, epochs: int,
                 pass  # best effort — the original failure propagates
         raise
     finally:
+        if ctrl is not None:
+            ctrl.close()
         mgr.close()
     return ran

@@ -65,6 +65,15 @@ class ResumeMismatchError(RuntimeError):
     would silently land in the wrong place."""
 
 
+class StrategyMismatchError(RuntimeError):
+    """The checkpoint was taken under a different parallelization
+    strategy than the model compiled with (``strategy_hash`` in
+    ``resume_meta.json`` vs the live map) — a mid-run reconfiguration,
+    or a changed import/search.  The restore itself is layout-portable;
+    this names the semantic drift instead of silently resuming under a
+    strategy the checkpointed run never ran."""
+
+
 class Preempted(SystemExit):
     """Raised by the elastic loop after a preemption save.  Subclasses
     SystemExit with code 0: unhandled, the process exits cleanly —

@@ -150,7 +150,8 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
                 measure: bool = False, seed: int = 0,
                 overlap_backward_update: Optional[bool] = None,
                 verbose: bool = True,
-                cost_model: Optional[CostModel] = None) -> "SearchResult":
+                cost_model: Optional[CostModel] = None,
+                num_devices: Optional[int] = None) -> "SearchResult":
     """Returns the best strategy map found (op name → ParallelConfig),
     as a ``SearchResult`` carrying the simulated best cost.
 
@@ -168,9 +169,14 @@ def mcmc_search(model, budget: int, alpha: float = 0.05,
     (pipeline_search's grid pass) share its memo caches with the anneal;
     only honored when its configuration matches what this function would
     build (measure=False path).
+
+    ``num_devices`` overrides the device count the search targets —
+    the online-reconfiguration path searches over the *surviving*
+    device set without mutating the compiled model's machine.
     """
-    nd = model.machine.num_devices if model.machine is not None \
-        else model.config.num_devices
+    nd = int(num_devices) if num_devices is not None \
+        else (model.machine.num_devices if model.machine is not None
+              else model.config.num_devices)
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
     overlap = model.config.search_overlap_backward_update \
         if overlap_backward_update is None else overlap_backward_update

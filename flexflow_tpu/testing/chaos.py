@@ -13,7 +13,7 @@ Spec grammar (``FF_CHAOS`` environment variable)::
     FF_CHAOS   = entry (";" entry)*
     entry      = site ":" trigger "=" fault [":" arg]
     site       = "step" | "data" | "ckpt_save" | "ckpt_restore" | "sync"
-               | "serve"
+               | "serve" | "resharding"
     trigger    = INT          exact trigger (fires once, then is spent)
                | "p" FLOAT    per-call probability (seeded, repeatable)
     fault      = "nan_loss"   poison the staged batch's float leaves with
@@ -26,7 +26,21 @@ Spec grammar (``FF_CHAOS`` environment variable)::
                | "sigterm"    os.kill(self, SIGTERM) — a preemption
                | "sigint"     os.kill(self, SIGINT)
                | "error"      raise ChaosError (generic failure)
-    arg        = FLOAT        fault parameter (hang seconds)
+               | "device_loss"   ``arg`` (default 1) devices vanish from
+                              the mesh — recorded on ``lost_device_count``
+                              and observed by the reconfiguration
+                              controller's probe at its ``resharding``
+                              choke point (the controller re-searches
+                              over the survivors and hot-swaps)
+               | "device_gain"   ``arg`` (default 1) lost devices
+                              reappear (clamped at a whole mesh)
+               | "divergence" inflate every SUBSEQUENT measured step by
+                              ``arg`` seconds (default 0.05) — a planted
+                              perf regression for probation/rollback and
+                              sim-divergence tests; persistent, not
+                              one-shot
+    arg        = FLOAT        fault parameter (hang seconds, lost/regained
+                              device count, per-step inflation seconds)
 
 For the ``step`` site the trigger is the model's GLOBAL step index
 (``model._step_count`` at ``update()`` entry) — resume-aware, so an
@@ -42,6 +56,15 @@ so ``serve:2=error`` fails exactly the second admitted request, which
 must NOT kill the batch loop or any other request (the engine's
 per-request error isolation, tests/test_serving.py); ``serve:3=hang:2``
 wedges the loop thread for 2s, stalling every in-flight request.
+
+The ``resharding`` site fires from the reconfiguration controller's
+per-step-boundary hook (``runtime/reconfigure.py``), with the GLOBAL
+step index as trigger domain (resume-aware, like ``step``) — so
+``resharding:4=device_loss:4`` makes 4 devices vanish after step 4
+and the controller re-parallelizes over the 4 survivors.  Device
+loss/gain is *recorded state* (``lost_device_count``): on a virtual
+CPU mesh a chip cannot physically vanish, so the controller's probe
+reads the monkey instead of the hardware.
 
 Examples::
 
@@ -67,8 +90,10 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-SITES = ("step", "data", "ckpt_save", "ckpt_restore", "sync", "serve")
-FAULTS = ("nan_loss", "hang", "io_error", "sigterm", "sigint", "error")
+SITES = ("step", "data", "ckpt_save", "ckpt_restore", "sync", "serve",
+         "resharding")
+FAULTS = ("nan_loss", "hang", "io_error", "sigterm", "sigint", "error",
+         "device_loss", "device_gain", "divergence")
 
 
 class ChaosError(RuntimeError):
@@ -164,6 +189,10 @@ class ChaosMonkey:
         self._exact, self._prob = parse_spec(spec)
         self._counts: Dict[str, int] = {}
         self.fired: List[Tuple[str, int, str]] = []  # (site, trigger, fault)
+        # resharding-site state, read by the reconfiguration controller
+        self.lost_device_count = 0
+        # persistent per-step wall inflation (``divergence`` fault)
+        self.inflate_step_s = 0.0
 
     def describe(self) -> str:
         parts = [f"{s}:{t}={f}" for (s, t), (f, _) in sorted(self._exact.items())]
@@ -178,6 +207,10 @@ class ChaosMonkey:
         own trigger domain (the global step for ``step``); when None the
         per-site call counter supplies it.  Returns the fault name when
         one fired (after executing its side effect), else None."""
+        if site == "step" and self.inflate_step_s:
+            # a previously fired ``divergence`` fault: every step pays
+            # the planted inflation from here on
+            time.sleep(self.inflate_step_s)
         if index is None:
             idx = self._counts.get(site, 0) + 1
             self._counts[site] = idx
@@ -225,6 +258,13 @@ class ChaosMonkey:
             os.kill(os.getpid(), signal.SIGINT)
         elif fault == "error":
             raise ChaosError(f"chaos-injected error at {where}")
+        elif fault == "device_loss":
+            self.lost_device_count += int(arg) if arg else 1
+        elif fault == "device_gain":
+            self.lost_device_count = max(
+                0, self.lost_device_count - (int(arg) if arg else 1))
+        elif fault == "divergence":
+            self.inflate_step_s = float(arg) if arg is not None else 0.05
 
     @staticmethod
     def _poison_batch(model, where: str) -> None:

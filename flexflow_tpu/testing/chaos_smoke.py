@@ -1,15 +1,27 @@
-"""Chaos smoke: the acceptance scenario for the recovery layer, as a CLI.
+"""Chaos smoke: the acceptance scenarios for the robustness layer, as a CLI.
 
-One seeded ``FF_CHAOS`` run injects a NaN step, a mid-epoch SIGTERM, and
-a failing checkpoint write; the resumed run must finish with parameters
-BITWISE-equal to an uninterrupted baseline, leave no partial checkpoint
-file behind, and the trace must narrate every recovery
-(``fault_injected`` / ``step_skipped`` / ``preemption_save`` /
-``ckpt_retry``).  Run by ``test.sh``; also a handy pod-shell sanity
-check after touching the recovery layer.
+Two scenarios, selected with ``--scenario``:
+
+``recovery`` (default) — one seeded ``FF_CHAOS`` run injects a NaN step,
+a mid-epoch SIGTERM, and a failing checkpoint write; the resumed run
+must finish with parameters BITWISE-equal to an uninterrupted baseline,
+leave no partial checkpoint file behind, and the trace must narrate
+every recovery (``fault_injected`` / ``step_skipped`` /
+``preemption_save`` / ``ckpt_retry``).
+
+``reshard`` — a chaos-injected loss of half the device mesh mid-run;
+the reconfiguration controller must re-search on the survivors, hot-swap
+at a deterministic step boundary, finish training finite on the
+degraded mesh, and leave a diffable pair of swap ``.pb`` records behind.
+Two independent runs must produce bitwise-identical parameters — the
+failover itself is deterministic.
+
+Run by ``test.sh``; also a handy pod-shell sanity check after touching
+the robustness layer.
 
 Usage:
     python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/chaos
+    python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/rs --scenario reshard
 """
 
 from __future__ import annotations
@@ -55,7 +67,9 @@ def _phase(env: dict):
     from ..observability import events
 
     events.reset_active()
-    for k in ("FF_CHAOS", "FF_TELEMETRY", "FF_TELEMETRY_FILE"):
+    for k in ("FF_CHAOS", "FF_TELEMETRY", "FF_TELEMETRY_FILE",
+              "FF_RECONFIGURE", "FF_RECONFIG_BUDGET",
+              "FF_RECONFIG_LAG_STEPS"):
         os.environ.pop(k, None)
     os.environ.update(env)
 
@@ -64,9 +78,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--workdir", required=True,
                    help="scratch dir for checkpoints + traces")
+    p.add_argument("--scenario", choices=("recovery", "reshard"),
+                   default="recovery",
+                   help="recovery = NaN/SIGTERM/io_error resume drill; "
+                        "reshard = chaos device loss + hot-swap failover")
     args = p.parse_args(argv)
     os.makedirs(args.workdir, exist_ok=True)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.scenario == "reshard":
+        # the failover drill needs a mesh to shrink — must be set before
+        # the first jax import touches the backend
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        return _scenario_reshard(args.workdir)
+    return _scenario_recovery(args.workdir)
+
+
+def _scenario_recovery(wd: str) -> int:
     os.environ["FF_SKIP_NONFINITE"] = "5"
     os.environ["FF_CKPT_BACKOFF_S"] = "0.01"
 
@@ -76,7 +104,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ..runtime.elastic import elastic_train
     from ..runtime.resilience import Preempted, read_resume_meta
 
-    wd = args.workdir
     trace = os.path.join(wd, "victim_trace.jsonl")
 
     # -- baseline: uninterrupted, same NaN injection ---------------------
@@ -128,6 +155,69 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"trace: {injected} faults injected, all recovery events "
           f"present ({trace})", flush=True)
     print("CHAOS SMOKE OK")
+    return 0
+
+
+def _reshard_run(wd: str):
+    """One seeded failover run: lose 4 of 8 devices after step 4, let
+    the controller re-search and hot-swap.  Returns (model, swap attrs,
+    trace path)."""
+    import numpy as np
+
+    from ..runtime.elastic import elastic_train
+
+    trace = os.path.join(wd, "trace.jsonl")
+    _phase({"FF_CHAOS": "resharding:4=device_loss:4",
+            "FF_RECONFIGURE": "1", "FF_RECONFIG_BUDGET": "40",
+            "FF_RECONFIG_LAG_STEPS": "2",
+            "FF_TELEMETRY": "1", "FF_TELEMETRY_FILE": trace})
+    m, dl = _build()
+    elastic_train(m, dl, epochs=EPOCHS,
+                  checkpoint_dir=os.path.join(wd, "ckpt"))
+    assert m.machine.num_devices == 4, \
+        f"expected a degraded 4-device mesh, got {m.machine.num_devices}"
+    k = np.asarray(m.get_parameter("fc1", "kernel"))
+    assert np.isfinite(k).all(), "non-finite params after failover"
+    swaps = [json.loads(l)["attrs"] for l in open(trace)
+             if l.strip() and '"strategy_swap"' in l
+             and json.loads(l).get("name") == "strategy_swap"]
+    applied = [s for s in swaps if s.get("outcome") == "applied"]
+    assert len(applied) == 1, f"expected exactly one applied swap: {swaps}"
+    assert applied[0]["trigger"] == "device_loss", applied[0]
+    return m, applied[0], trace
+
+
+def _scenario_reshard(wd: str) -> int:
+    import numpy as np
+
+    from ..observability import events
+    from ..tools.search_report import render_diff
+
+    m1, swap, _trace = _reshard_run(os.path.join(wd, "run1"))
+    print(f"run1: swap at step {swap['step']} "
+          f"({swap['old_devices']} -> {swap['new_devices']} devices)",
+          flush=True)
+
+    # the flight recorder left a diffable pair of strategy records
+    old_pb, new_pb = swap["old_pb"], swap["new_pb"]
+    assert os.path.exists(old_pb) and os.path.exists(new_pb), \
+        (old_pb, new_pb)
+    diff = render_diff(old_pb, new_pb)
+    assert "reconfig-mcmc" in diff, "diff lost the new side's engine"
+    print(f"diff: search_report --diff renders {os.path.basename(old_pb)} "
+          f"vs {os.path.basename(new_pb)} ({len(diff.splitlines())} lines)",
+          flush=True)
+
+    # determinism: an independent run reproduces the failover bitwise
+    m2, swap2, _ = _reshard_run(os.path.join(wd, "run2"))
+    events.reset_active()
+    assert swap2["step"] == swap["step"], (swap2["step"], swap["step"])
+    k1 = np.asarray(m1.get_parameter("fc1", "kernel"))
+    k2 = np.asarray(m2.get_parameter("fc1", "kernel"))
+    assert (k1 == k2).all(), "failover runs are not bitwise-reproducible"
+    print(f"run2: swap at step {swap2['step']}, params bitwise-equal "
+          "to run1", flush=True)
+    print("RESHARD SMOKE OK")
     return 0
 
 

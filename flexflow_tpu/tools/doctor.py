@@ -140,6 +140,39 @@ def _resilience():
     return ", ".join(bits)
 
 
+def _reconfiguration():
+    # Effective FF_RECONFIG_* env as reconfigure.py will see it — a
+    # typo'd threshold fails HERE (ValueError in the detail) instead of
+    # at the first divergence window, hours into a run.  When the
+    # feature is armed, also probe the search engine the controller's
+    # background thread will call: a tiny-budget seeded MCMC over the
+    # doctor's toy graph, host-only, so a broken native/simulator stack
+    # is a launch-time finding rather than a mid-swap reconfig_error.
+    from ..runtime.reconfigure import ReconfigPolicy
+
+    policy = ReconfigPolicy.from_env()  # ValueError on a bad knob
+    if policy is None:
+        return "FF_RECONFIGURE=off"
+    bits = [f"FF_RECONFIGURE=on, {policy.describe()}"]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import flexflow_tpu as ff
+    from ..simulator.search import mcmc_search
+
+    cfg = ff.FFConfig(batch_size=16)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((16, 8), nchw=False, name="x")
+    t = m.dense(t, 16, name="fc1")
+    m.softmax(t, name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    res = mcmc_search(m, num_devices=4, budget=4, seed=0, verbose=False)
+    bits.append(f"search probe: best {res.best_s * 1e3:.3f} ms "
+                f"(budget 4, 4 devices)")
+    return ", ".join(bits)
+
+
 def _serving():
     # Effective FF_SERVE_* env as serving/config.py will see it (a bad
     # value raises here, not at server startup), plus a bind probe of
@@ -268,6 +301,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              ("observability", _observability, False),
              ("perf", lambda: _perf(probe=not args.skip_accelerator), False),
              ("resilience", _resilience, False),
+             ("reconfiguration", _reconfiguration, False),
              ("serving", _serving, False),
              ("cpu training", _cpu_train, True)]
 

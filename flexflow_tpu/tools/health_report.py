@@ -13,6 +13,10 @@ simulator".  Sections:
     per-op table from ``sim_divergence`` events (ratio per op/dir,
     worst-case band) — rows slot into CALIBRATION.md's multi-point
     validation table,
+  * reconfiguration: online re-parallelization searches and strategy
+    hot-swaps (``reconfig_search`` / ``strategy_swap`` events from
+    runtime/reconfigure.py) with per-swap outcome, simulated gain,
+    measured probation result, and rollbacks,
   * last heartbeat / bench phase seen in the trace.
 
 STDLIB-ONLY: a pod trace must be foldable on any laptop.
@@ -214,6 +218,54 @@ def render_report(records: List[Dict[str, Any]]) -> str:
             lines.append(f"- device hangs detected: {len(hangs)} "
                          f"({a.get('stranded', '?')} watchdog worker(s) "
                          "stranded)")
+        lines.append("")
+
+    # ---- reconfiguration (reconfigure.py narration) -------------------
+    searches = events.get("reconfig_search", [])
+    swaps = events.get("strategy_swap", [])
+    rerrors = events.get("reconfig_error", [])
+    if searches or swaps or rerrors:
+        lines.append("## Reconfiguration")
+        lines.append("")
+        if searches:
+            a = searches[-1].get("attrs", {})
+            lines.append(f"- re-parallelization searches launched: "
+                         f"{len(searches)} (last: trigger "
+                         f"`{a.get('trigger', '?')}` at step "
+                         f"{a.get('step', '?')}, {a.get('num_devices', '?')} "
+                         f"devices, budget {a.get('budget', '?')})")
+        if swaps:
+            lines.append("")
+            lines.append("| step | trigger | outcome | devices | sim gain "
+                         "| measured p50 pre -> post ms |")
+            lines.append("|---|---|---|---|---|---|")
+            for e in swaps:
+                a = e.get("attrs", {})
+                dev = ""
+                if a.get("old_devices") is not None:
+                    dev = f"{a['old_devices']} -> {a.get('new_devices', '?')}"
+                gain = a.get("gain")
+                gain = f"{100 * float(gain):.1f}%" if gain is not None else ""
+                pre, post = a.get("measured_pre_ms"), a.get("measured_post_ms")
+                meas = (f"{float(pre):.1f} -> {float(post):.1f}"
+                        if pre is not None and post is not None else "")
+                lines.append(f"| {a.get('step', '?')} | "
+                             f"{a.get('trigger', '?')} | "
+                             f"{a.get('outcome', '?')} | {dev} | {gain} | "
+                             f"{meas} |")
+            rolled = [e for e in swaps
+                      if e.get("attrs", {}).get("outcome") == "rolled_back"]
+            if rolled:
+                a = rolled[-1].get("attrs", {})
+                lines.append("")
+                lines.append(f"- rollbacks: {len(rolled)} (last: swap at "
+                             f"step {a.get('swap_step', '?')} regressed "
+                             f"{a.get('regress_factor', '?')}x measured — "
+                             "reverted to the pre-swap strategy)")
+        if rerrors:
+            a = rerrors[-1].get("attrs", {})
+            lines.append(f"- search errors: {len(rerrors)} (last: "
+                         f"{a.get('error', '?')})")
         lines.append("")
 
     # ---- heartbeat / phases -------------------------------------------

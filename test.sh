@@ -152,6 +152,18 @@ python -m flexflow_tpu.tools.trace_report "$SMOKE_DIR/chaos/victim_trace.jsonl" 
   || { echo "chaos smoke: trace report missing resilience section"; exit 1; }
 echo "chaos smoke: OK"
 
+# Reshard smoke: chaos kills half the mesh mid-run; the reconfiguration
+# controller must re-search on the survivors, hot-swap deterministically,
+# leave a diffable swap-record pair, and health_report must narrate the
+# swap (docs/robustness.md "Online re-parallelization").
+python -m flexflow_tpu.testing.chaos_smoke --workdir "$SMOKE_DIR/reshard" \
+    --scenario reshard \
+  || { echo "reshard smoke: FAILED"; exit 1; }
+python -m flexflow_tpu.tools.health_report "$SMOKE_DIR/reshard/run1/trace.jsonl" \
+  | grep -q "## Reconfiguration" \
+  || { echo "reshard smoke: health report missing reconfiguration section"; exit 1; }
+echo "reshard smoke: OK"
+
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \
             examples/keras/seq_mnist_mlp.py \

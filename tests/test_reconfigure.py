@@ -1,0 +1,426 @@
+"""Online re-parallelization (runtime/reconfigure.py).
+
+The self-healing loop the reference cannot express (fail-stop, no
+checkpointing, strategies fixed at compile — SURVEY §5.3/5.4): a seeded
+chaos device loss mid-training triggers a background re-search over the
+surviving mesh and a step-boundary hot-swap through the elastic
+checkpoint/resume path; training runs to completion on the degraded
+mesh, deterministically.  A planted post-swap regression rolls back to
+the old strategy inside the probation window.  Every swap/rollback is a
+``strategy_swap`` event plus an old/new ``.pb`` + sidecar pair
+renderable by ``search_report --diff``.
+"""
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.observability import events
+from flexflow_tpu.parallel.strategy import strategies_fingerprint
+from flexflow_tpu.runtime import reconfigure
+from flexflow_tpu.runtime.elastic import (DeviceHangError, StepWatchdog,
+                                          elastic_train)
+from flexflow_tpu.runtime.reconfigure import (ReconfigPolicy,
+                                              ReconfigurationController,
+                                              maybe_controller,
+                                              refit_machine_model)
+from flexflow_tpu.runtime.resilience import StrategyMismatchError
+
+RECONFIG_KEYS = ("FF_RECONFIGURE", "FF_RECONFIG_GAIN",
+                 "FF_RECONFIG_PROBATION", "FF_RECONFIG_DIVERGENCE",
+                 "FF_RECONFIG_SUSTAIN", "FF_RECONFIG_BUDGET",
+                 "FF_RECONFIG_LAG_STEPS", "FF_RECONFIG_REGRESS",
+                 "FF_RECONFIG_SEED", "FF_RECONFIG_DIR")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in RECONFIG_KEYS + ("FF_CHAOS", "FF_CHAOS_SEED", "FF_TELEMETRY",
+                              "FF_TELEMETRY_FILE", "FF_HEALTH"):
+        monkeypatch.delenv(k, raising=False)
+    events.reset_active()
+    yield
+    events.reset_active()
+
+
+def _build(strategies=None, n_samples=48, seed=9):
+    cfg = ff.FFConfig(batch_size=16)
+    if strategies:
+        cfg.strategies.update(strategies)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 8), nchw=False, name="input")
+    t = m.dense(inp, 16, activation="relu", name="fc1")
+    t = m.dense(t, 4, name="fc2")
+    m.softmax(t, name="sm")
+    m.compile(ff.AdamOptimizer(alpha=0.01),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=seed)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((n_samples, 8), dtype=np.float32)
+    y = rng.integers(0, 4, size=(n_samples, 1), dtype=np.int32)
+    dl = ff.DataLoader(m, {inp: x}, y, seed=5)
+    return m, dl
+
+
+def _swap_events(trace):
+    out = []
+    with open(trace) as f:
+        for line in f:
+            if line.strip() and '"strategy_swap"' in line:
+                rec = json.loads(line)
+                if rec.get("name") == "strategy_swap":
+                    out.append(rec["attrs"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# policy / knobs
+# ---------------------------------------------------------------------------
+
+def test_policy_from_env(monkeypatch):
+    assert ReconfigPolicy.from_env() is None  # unset -> zero overhead
+    monkeypatch.setenv("FF_RECONFIGURE", "1")
+    monkeypatch.setenv("FF_RECONFIG_GAIN", "0.1")
+    monkeypatch.setenv("FF_RECONFIG_PROBATION", "5")
+    pol = ReconfigPolicy.from_env()
+    assert pol.gain == 0.1 and pol.probation == 5
+    assert "probation=5" in pol.describe()
+
+    monkeypatch.setenv("FF_RECONFIG_GAIN", "lots")
+    with pytest.raises(ValueError, match="FF_RECONFIG_GAIN"):
+        ReconfigPolicy.from_env()  # a typo'd knob is named, not ignored
+    monkeypatch.setenv("FF_RECONFIG_GAIN", "0.1")
+    monkeypatch.setenv("FF_RECONFIG_REGRESS", "0.9")
+    with pytest.raises(ValueError, match="FF_RECONFIG_REGRESS"):
+        ReconfigPolicy.from_env()
+
+
+def test_refit_quantizes_and_clamps():
+    base8 = refit_machine_model(8)
+    base4 = refit_machine_model(4)
+    assert base8.num_devices == 8 and base4.num_devices == 4
+    # CPU walls vs a TPU prediction: a ratio >> 4 clamps to the 4x bucket
+    slow = refit_machine_model(4, predicted_s=1e-5, measured_s=1e-2)
+    assert slow.mxu_efficiency == pytest.approx(base4.mxu_efficiency / 4.0)
+    # near-1 ratios quantize to the identity bucket — per-run wall noise
+    # must not flip which strategy the seeded re-search returns
+    near = refit_machine_model(8, predicted_s=1.0, measured_s=1.2)
+    assert near.mxu_efficiency == base8.mxu_efficiency
+
+
+def test_zero_overhead_when_unset(tmp_path, monkeypatch):
+    """FF_RECONFIGURE unset: no controller is even constructed — the
+    loop pays one `is not None` test per step."""
+    def boom(*a, **k):
+        raise AssertionError("controller constructed with "
+                             "FF_RECONFIGURE unset")
+
+    monkeypatch.setattr(reconfigure, "ReconfigurationController", boom)
+    assert maybe_controller(object(), None, str(tmp_path)) is None
+    m, dl = _build()
+    assert elastic_train(m, dl, epochs=1,
+                         checkpoint_dir=str(tmp_path / "ckpt")) == 1
+    assert not hasattr(m, "_reconfig")
+
+
+# ---------------------------------------------------------------------------
+# trigger streams
+# ---------------------------------------------------------------------------
+
+def test_divergence_observer_arms_after_sustained_windows(tmp_path):
+    m, _ = _build()
+    ctrl = ReconfigurationController(
+        m, None, str(tmp_path),
+        policy=ReconfigPolicy(divergence=1.5, sustain=2))
+    div = lambda ratio: {"t": "event", "name": "sim_divergence",
+                         "attrs": {"scope": "step", "ratio": ratio}}
+    ctrl._observe(div(0.5))          # 2x off — window 1 of 2
+    assert ctrl._pending is None
+    ctrl._observe(div(1.1))          # back within threshold: streak resets
+    ctrl._observe(div(0.5))
+    assert ctrl._pending is None
+    ctrl._observe(div(2.0))          # 2nd consecutive bad window -> armed
+    assert ctrl._pending[0] == "divergence"
+    # non-step scopes and other events never count
+    ctrl._pending = None
+    ctrl._observe({"t": "event", "name": "sim_divergence",
+                   "attrs": {"scope": "epoch", "ratio": 9.0}})
+    ctrl._observe({"t": "event", "name": "step", "attrs": {"ratio": 9.0}})
+    assert ctrl._pending is None
+
+
+def test_chaos_device_loss_and_gain_probe(tmp_path, monkeypatch):
+    from flexflow_tpu.testing.chaos import ChaosMonkey
+
+    m, _ = _build()
+    m._chaos = ChaosMonkey("resharding:2=device_loss:4;"
+                           "resharding:5=device_gain:4")
+    ctrl = ReconfigurationController(m, None, str(tmp_path),
+                                     policy=ReconfigPolicy())
+    fired = []
+    monkeypatch.setattr(
+        ctrl, "_launch",
+        lambda: (fired.append(ctrl._pending),
+                 setattr(ctrl, "_pending", None)))
+    for step in range(1, 7):
+        m._step_count = step
+        ctrl.on_step()
+    assert [t for (t, _) in fired] == ["device_loss", "device_gain"]
+    assert fired[0][1]["lost"] == [4, 5, 6, 7]
+    assert fired[1][1]["lost"] == []
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: seeded end-to-end hot swap on device loss
+# ---------------------------------------------------------------------------
+
+def _run_device_loss(workdir, monkeypatch):
+    monkeypatch.setenv("FF_RECONFIGURE", "1")
+    monkeypatch.setenv("FF_RECONFIG_BUDGET", "40")
+    monkeypatch.setenv("FF_RECONFIG_LAG_STEPS", "2")
+    monkeypatch.setenv("FF_CHAOS", "resharding:4=device_loss:4")
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", f"{workdir}/trace.jsonl")
+    events.reset_active()
+    m, dl = _build()
+    ran = elastic_train(m, dl, epochs=3, checkpoint_dir=f"{workdir}/ckpt")
+    events.reset_active()
+    return m, ran
+
+
+def test_device_loss_hot_swap_e2e_deterministic(tmp_path, monkeypatch):
+    from flexflow_tpu.tools.search_report import read_sidecar, render_diff
+
+    m1, ran1 = _run_device_loss(tmp_path / "a", monkeypatch)
+    # training survived the loss of half the mesh and finished on it
+    assert ran1 == 3 and m1._step_count == 9
+    assert m1.machine.num_devices == 4
+    k1 = np.asarray(m1._params["fc1"]["kernel"])
+    assert np.isfinite(k1).all()
+    # every surviving op really runs on <= 4 parts
+    assert all(pc.num_parts() <= 4 for pc in m1._all_strategies().values())
+
+    swaps = _swap_events(tmp_path / "a" / "trace.jsonl")
+    applied = [s for s in swaps if s["outcome"] == "applied"]
+    assert len(applied) == 1
+    a = applied[0]
+    assert a["trigger"] == "device_loss" and a["new_devices"] == 4
+    # deterministic apply boundary: chaos fires at step 4, lag 2 -> swap
+    # lands at step 6 regardless of how fast the search thread ran
+    assert a["step"] == 6
+    assert a["probation"] == "skipped_device_change"
+    assert m1._reconfig.swaps == [(6, "device_loss", "applied")]
+
+    # the flight recorder: old/new .pb + sidecar, diffable
+    assert os.path.exists(a["old_pb"]) and os.path.exists(a["new_pb"])
+    meta_old, status = read_sidecar(a["old_pb"])
+    assert status == "ok" and meta_old["engine"] == "active"
+    assert meta_old["num_devices"] == 8
+    assert meta_old["reconfig_trigger"] == "device_loss"
+    meta_new, status = read_sidecar(a["new_pb"])
+    assert status == "ok" and meta_new["engine"] == "reconfig-mcmc"
+    assert meta_new["num_devices"] == 4 and meta_new["budget"] == 40
+    out = render_diff(a["old_pb"], a["new_pb"])
+    assert "changed /" in out and "reconfig-mcmc" in out
+
+    # run-to-run determinism given the chaos seed: bitwise-equal params
+    m2, _ = _run_device_loss(tmp_path / "b", monkeypatch)
+    k2 = np.asarray(m2._params["fc1"]["kernel"])
+    assert np.array_equal(k1, k2)
+    assert _swap_events(tmp_path / "b" / "trace.jsonl")[0]["step"] == 6
+
+
+# ---------------------------------------------------------------------------
+# acceptance gate + probation
+# ---------------------------------------------------------------------------
+
+def test_no_swap_below_gain_threshold(tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_RECONFIGURE", "1")
+    monkeypatch.setenv("FF_RECONFIG_BUDGET", "40")
+    monkeypatch.setenv("FF_RECONFIG_GAIN", "0.99")  # unreachable bar
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", str(tmp_path / "trace.jsonl"))
+    events.reset_active()
+    m, dl = _build()
+    before = strategies_fingerprint(m._all_strategies())
+
+    def kick(epoch, _metrics):
+        if epoch == 0:
+            m._reconfig.request("divergence", ratio=3.0)
+
+    elastic_train(m, dl, epochs=3, checkpoint_dir=str(tmp_path / "ckpt"),
+                  on_epoch=kick)
+    swaps = _swap_events(tmp_path / "trace.jsonl")
+    assert [s["outcome"] for s in swaps] == ["rejected_gain"]
+    assert swaps[0]["threshold"] == 0.99
+    # nothing swapped: same strategies, same mesh, no flight records
+    assert strategies_fingerprint(m._all_strategies()) == before
+    assert m.machine.num_devices == 8
+    assert not glob.glob(str(tmp_path / "ckpt" / "reconfig" / "*.pb"))
+
+
+def test_probation_rollback_on_planted_regression(tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_RECONFIGURE", "1")
+    monkeypatch.setenv("FF_RECONFIG_BUDGET", "40")
+    monkeypatch.setenv("FF_RECONFIG_LAG_STEPS", "2")
+    monkeypatch.setenv("FF_RECONFIG_GAIN", "-10")   # accept any swap
+    monkeypatch.setenv("FF_RECONFIG_PROBATION", "3")
+    # the planted regression: after the swap lands at step 6, every
+    # subsequent step is inflated by 150 ms (chaos divergence fault)
+    monkeypatch.setenv("FF_CHAOS", "resharding:7=divergence:0.15")
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", str(tmp_path / "trace.jsonl"))
+    events.reset_active()
+    m, dl = _build()
+    before = strategies_fingerprint(m._all_strategies())
+
+    def kick(epoch, _metrics):
+        if epoch == 0:
+            m._reconfig.request("divergence", ratio=2.0)
+
+    elastic_train(m, dl, epochs=5, checkpoint_dir=str(tmp_path / "ckpt"),
+                  on_epoch=kick)
+    assert m._reconfig.swaps[0] == (6, "divergence", "applied")
+    assert [o for (_, _, o) in m._reconfig.swaps] == ["applied",
+                                                      "rolled_back"]
+    swaps = _swap_events(tmp_path / "trace.jsonl")
+    rb = [s for s in swaps if s["outcome"] == "rolled_back"][0]
+    assert rb["swap_step"] == 6
+    assert rb["measured_post_ms"] > rb["measured_pre_ms"] * 1.3
+    # rolled back TO the pre-swap strategy; training then completed
+    assert strategies_fingerprint(m._all_strategies()) == before
+    assert m._step_count == 15
+    assert np.isfinite(np.asarray(m._params["fc1"]["kernel"])).all()
+    # both halves of the swap are on disk for the flight recorder
+    assert len(glob.glob(str(tmp_path / "ckpt" / "reconfig" / "*.pb"))) == 2
+
+
+def test_probation_ok_keeps_new_strategy(tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_RECONFIGURE", "1")
+    monkeypatch.setenv("FF_RECONFIG_BUDGET", "40")
+    monkeypatch.setenv("FF_RECONFIG_GAIN", "-10")
+    monkeypatch.setenv("FF_RECONFIG_PROBATION", "3")
+    # headroom for CPU wall noise — no planted regression here
+    monkeypatch.setenv("FF_RECONFIG_REGRESS", "5.0")
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", str(tmp_path / "trace.jsonl"))
+    events.reset_active()
+    m, dl = _build()
+
+    def kick(epoch, _metrics):
+        if epoch == 0:
+            m._reconfig.request("divergence", ratio=2.0)
+
+    elastic_train(m, dl, epochs=4, checkpoint_dir=str(tmp_path / "ckpt"),
+                  on_epoch=kick)
+    outcomes = [s["outcome"] for s in _swap_events(tmp_path / "trace.jsonl")]
+    assert outcomes == ["applied", "probation_ok"]
+
+
+# ---------------------------------------------------------------------------
+# resume-after-reconfigure (strategy hash in resume_meta.json)
+# ---------------------------------------------------------------------------
+
+def test_resume_meta_records_strategy_hash(tmp_path):
+    m, dl = _build()
+    elastic_train(m, dl, epochs=1, checkpoint_dir=str(tmp_path))
+    with open(tmp_path / "resume_meta.json") as f:
+        meta = json.load(f)
+    assert meta["strategy_hash"] == \
+        strategies_fingerprint(m._all_strategies())
+
+
+def test_strategy_mismatch_on_resume(tmp_path):
+    m, dl = _build()
+    elastic_train(m, dl, epochs=1, checkpoint_dir=str(tmp_path))
+
+    changed = {"fc1": ff.ParallelConfig(dims=(4, 2))}  # hybrid, not dp8
+    m2, dl2 = _build(strategies=changed)
+    with pytest.raises(StrategyMismatchError, match="strategy"):
+        elastic_train(m2, dl2, epochs=2, checkpoint_dir=str(tmp_path))
+    # recompute mirrors on_steps_mismatch: warn, continue on the
+    # compiled strategies (the restore itself is layout-portable)
+    m3, dl3 = _build(strategies=changed)
+    with pytest.warns(RuntimeWarning, match="strategy"):
+        ran = elastic_train(m3, dl3, epochs=2,
+                            checkpoint_dir=str(tmp_path),
+                            on_strategy_mismatch="recompute")
+    assert ran == 1 and m3._step_count == 6
+    assert np.isfinite(np.asarray(m3._params["fc1"]["kernel"])).all()
+
+
+def test_elastic_train_rejects_bad_on_strategy_mismatch(tmp_path):
+    m, dl = _build()
+    with pytest.raises(ValueError, match="on_strategy_mismatch"):
+        elastic_train(m, dl, epochs=1, checkpoint_dir=str(tmp_path),
+                      on_strategy_mismatch="explode")
+
+
+# ---------------------------------------------------------------------------
+# recompile-in-place (the hot-swap half, without a controller)
+# ---------------------------------------------------------------------------
+
+def test_recompile_preserves_training_state():
+    m, dl = _build()
+    for _ in range(3):
+        dl.next_batch(m)
+        m.train_iteration()
+    m.sync()
+    before = np.asarray(m._params["fc1"]["kernel"])
+    step = m._step_count
+    m.recompile(strategies={"fc1": ff.ParallelConfig(dims=(4, 2))})
+    assert m._step_count == step  # live state survived, bit for bit
+    assert np.array_equal(np.asarray(m._params["fc1"]["kernel"]), before)
+    assert m._all_strategies()["fc1"].num_parts() == 8
+    for _ in range(3):  # and the rebuilt step function still trains
+        dl.next_batch(m)
+        m.train_iteration()
+    m.sync()
+    assert m._step_count == step + 3
+    assert np.isfinite(np.asarray(m._params["fc1"]["kernel"])).all()
+
+
+# ---------------------------------------------------------------------------
+# watchdog stranded-thread accounting (satellite)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_stranded_cap_and_gauge(tmp_path, monkeypatch):
+    trace = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", str(trace))
+    events.reset_active()
+    StepWatchdog._stranded.clear()
+    StepWatchdog._warned_sites.clear()
+    monkeypatch.setattr(StepWatchdog, "STRANDED_MAX", 4)
+    release = threading.Event()
+    try:
+        wd = StepWatchdog(timeout=0.02)
+        with pytest.warns(RuntimeWarning, match="stranded"):
+            for _ in range(7):
+                with pytest.raises(DeviceHangError):
+                    wd.run(release.wait)
+        # the bookkeeping is capped even though 7 workers are pinned
+        assert len(StepWatchdog._stranded) == 4
+        # one warning per distinct call site, not one per hang: the
+        # single loop site above warned exactly once
+        assert len(StepWatchdog._warned_sites) == 1
+        with pytest.warns(RuntimeWarning, match="stranded"):
+            with pytest.raises(DeviceHangError):
+                wd.run(release.wait)  # a DIFFERENT call site warns again
+        assert len(StepWatchdog._warned_sites) == 2
+    finally:
+        release.set()
+        StepWatchdog._stranded.clear()
+        StepWatchdog._warned_sites.clear()
+        events.reset_active()
+    with open(trace) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    gauges = [r for r in recs if r.get("t") == "gauge"
+              and r.get("name") == "stranded_count"]
+    assert len(gauges) == 8          # one per hang
+    assert gauges[-1]["v"] <= 4.0    # reflects the capped pile
