@@ -50,6 +50,17 @@ MAX_BACKOFF_S = 30.0
 
 RESUME_META_FILE = "resume_meta.json"
 
+
+def backoff_delay(attempt: int, base: float,
+                  cap: float = MAX_BACKOFF_S) -> float:
+    """Bounded exponential backoff: ``min(base * 2**(attempt-1), cap)``
+    for 1-based ``attempt``.  Shared by checkpoint retries and the
+    serving replica-pool restart loop so "how long do we wait before
+    trying again" has exactly one definition."""
+    if attempt < 1:
+        attempt = 1
+    return min(float(base) * (2.0 ** (attempt - 1)), float(cap))
+
 # Metric-vector entries the train step appends when the guard is on;
 # the drain pops them before PerfMetrics sees the dict (model.py).
 GUARD_METRIC_KEYS = ("skipped_steps", "consec_skipped")
@@ -237,7 +248,7 @@ def with_ckpt_retries(fn: Callable[[], Any], *, model=None,
         except OSError as e:
             if attempt > n:
                 raise
-            delay = min(base * (2 ** (attempt - 1)), MAX_BACKOFF_S)
+            delay = backoff_delay(attempt, base)
             if log is not None:
                 log.event("ckpt_retry", site=site, attempt=attempt,
                           error=f"{type(e).__name__}: {e}",

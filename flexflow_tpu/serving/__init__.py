@@ -7,7 +7,11 @@ Layers:
                 (stdlib-only)
 * ``engine``  — ``InferenceEngine``: slot-based kv pool + the
                 continuous-batching decode loop (imports jax)
+* ``pool``    — ``ReplicaPool``: N health-checked engine replicas
+                behind one admission queue — failover, load shedding,
+                hedging, graceful drain (imports jax via engine)
 * ``api``     — ``ServingAPI``: stdlib ThreadingHTTPServer front end
+                (backend: an engine or a pool)
 
 ``InferenceEngine`` is imported lazily so stdlib-only consumers
 (doctor, report CLIs) can read the config layer without touching jax.
@@ -15,16 +19,20 @@ Layers:
 
 from .config import ServeConfig
 from .queue import (InferenceRequest, RequestQueue, ServeError,
-                    ServeTimeout)
+                    ServeOverload, ServeTimeout)
 
-__all__ = ["InferenceEngine", "InferenceRequest", "RequestQueue",
-           "ServeConfig", "ServeError", "ServeTimeout", "ServingAPI"]
+__all__ = ["InferenceEngine", "InferenceRequest", "ReplicaPool",
+           "RequestQueue", "ServeConfig", "ServeError", "ServeOverload",
+           "ServeTimeout", "ServingAPI"]
 
 
 def __getattr__(name):
     if name == "InferenceEngine":
         from .engine import InferenceEngine
         return InferenceEngine
+    if name == "ReplicaPool":
+        from .pool import ReplicaPool
+        return ReplicaPool
     if name == "ServingAPI":
         from .api import ServingAPI
         return ServingAPI

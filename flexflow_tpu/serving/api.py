@@ -5,6 +5,10 @@ on the request future while the single engine loop batches the actual
 decoding — the classic many-waiters/one-worker shape, with zero
 dependencies beyond the standard library.
 
+The backend can be a single ``InferenceEngine`` or a ``ReplicaPool``
+(same ``submit``/``stats`` surface); with a pool the health endpoints
+expose per-replica state and shedding maps to 503 + ``Retry-After``.
+
 Endpoints::
 
   POST /generate   {"prompt": [int, ...], "max_new_tokens": 16,
@@ -13,8 +17,14 @@ Endpoints::
                         "queue_wait_s": .., "ttft_s": .., "tpot_s": ..}
               ->   400 malformed body / validation error
               ->   503 queue-wait timeout      (Retry-After: 1)
+              ->   503 admission shed          (Retry-After: estimate)
+              ->   503 pool draining / not accepting
               ->   500 engine-side failure
-  GET  /healthz -> 200 {"status": "ok", "uptime_s": .., ...engine stats}
+  GET  /healthz -> liveness: 200 while serving or draining (per-replica
+                   detail with a pool backend), 503 once no replica can
+                   serve
+  GET  /readyz  -> readiness: 200 iff new submits would be accepted —
+                   the load-balancer signal; 503 while draining or down
 
 Sampling knobs are rejected (400): the engine is greedy-only, which is
 what keeps its outputs bitwise-equal to ``FFModel.generate()``.
@@ -28,7 +38,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .queue import ServeError, ServeTimeout
+from .queue import ServeError, ServeOverload, ServeTimeout
 
 # request knobs forwarded verbatim to InferenceEngine.submit
 _SUBMIT_KEYS = ("priority", "timeout_s", "eos_id", "request_id")
@@ -57,13 +67,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 — http.server API
-        if self.path.split("?")[0] != "/healthz":
+        path = self.path.split("?")[0]
+        backend = self.api.engine
+        uptime = round(time.perf_counter() - self.api.t0, 3)
+        if path == "/healthz":
+            if hasattr(backend, "healthz"):        # ReplicaPool
+                payload = backend.healthz()
+                code = 200 if payload["status"] in ("ok", "draining") \
+                    else 503
+            else:                                  # bare InferenceEngine
+                payload = backend.stats()
+                payload["status"] = "ok"
+                code = 200
+            payload["uptime_s"] = uptime
+            self._reply(code, payload)
+        elif path == "/readyz":
+            if hasattr(backend, "ready"):          # ReplicaPool
+                ready = bool(backend.ready())
+            else:
+                ready = bool(getattr(backend, "_accepting", False))
+            self._reply(200 if ready else 503,
+                        {"ready": ready, "uptime_s": uptime})
+        else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
-            return
-        stats = self.api.engine.stats()
-        stats.update(status="ok",
-                     uptime_s=round(time.perf_counter() - self.api.t0, 3))
-        self._reply(200, stats)
 
     def do_POST(self) -> None:  # noqa: N802 — http.server API
         if self.path.split("?")[0] != "/generate":
@@ -81,6 +107,16 @@ class _Handler(BaseHTTPRequestHandler):
             kw = {k: body[k] for k in _SUBMIT_KEYS if body.get(k) is not None}
             req = self.api.engine.submit(
                 prompt, body.get("max_new_tokens"), **kw)
+        except ServeOverload as e:
+            # admission control shed this request: tell the client when
+            # to come back instead of letting latency collapse
+            self._reply(503, {"error": str(e)},
+                        Retry_After=max(1, round(e.retry_after_s)))
+            return
+        except ServeError as e:
+            # not accepting (draining, stopped) — also a retryable 503
+            self._reply(503, {"error": str(e)}, Retry_After=1)
+            return
         except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"{type(e).__name__}: {e}"})
             return
@@ -106,7 +142,8 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ServingAPI:
-    """Owns the HTTP server; pair with a started ``InferenceEngine``.
+    """Owns the HTTP server; pair with a started ``InferenceEngine``
+    or ``ReplicaPool`` (both expose ``submit``/``stats``/``config``).
 
     ``port=0`` binds an ephemeral port (tests); read ``api.port`` after
     ``start()``.  ``result_timeout_s`` bounds how long a handler thread

@@ -26,6 +26,28 @@ Knobs (env var -> field):
                                            status "timeout" (0: wait forever)
   FF_SERVE_HOST           host             HTTP bind host
   FF_SERVE_PORT           port             HTTP bind port (0: ephemeral)
+
+Replica-pool knobs (serving/pool.py; all inert for a bare engine):
+
+  FF_SERVE_REPLICAS        replicas           engine replicas behind the one
+                                              admission queue (1: no pool)
+  FF_SERVE_MAX_QUEUE       max_queue          admission-control bound on the
+                                              shared queue; submits beyond it
+                                              are SHED with 503 + Retry-After
+                                              (0: unbounded — today's behavior)
+  FF_SERVE_SHED_WAIT_S     shed_wait_s        also shed when the estimated
+                                              backlog drain time exceeds this
+                                              many seconds (0: count-only)
+  FF_SERVE_REPLICA_TIMEOUT replica_timeout_s  decode-progress heartbeat
+                                              staleness that marks a replica
+                                              UNHEALTHY (drain + restart)
+  FF_SERVE_HEDGE_MS        hedge_ms           re-dispatch a request still
+                                              unfinished after this many ms to
+                                              a second replica; first finisher
+                                              wins, loser cancelled (0: off)
+  FF_SERVE_RESTART_BACKOFF_S restart_backoff_s  base of the bounded
+                                              exponential restart backoff
+  FF_SERVE_RESTART_CAP_S   restart_cap_s      backoff ceiling
 """
 
 from __future__ import annotations
@@ -73,6 +95,14 @@ class ServeConfig:
     poll_interval_s: float = 0.02      # idle-loop wait granularity
     host: str = "127.0.0.1"
     port: int = 8000
+    # replica pool (inert for a bare InferenceEngine)
+    replicas: int = 1
+    max_queue: int = 0                 # 0: unbounded (no shedding)
+    shed_wait_s: float = 0.0           # 0: count-based shedding only
+    replica_timeout_s: float = 10.0
+    hedge_ms: float = 0.0              # 0: hedging off
+    restart_backoff_s: float = 0.5
+    restart_cap_s: float = 30.0
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -92,6 +122,18 @@ class ServeConfig:
             raise ValueError(
                 f"largest bucket {self.buckets[-1]} leaves no room for a "
                 f"generated token (max_seq={self.max_seq})")
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {self.max_queue}")
+        if self.replica_timeout_s <= 0:
+            raise ValueError(f"replica_timeout_s must be > 0, "
+                             f"got {self.replica_timeout_s}")
+        for name in ("shed_wait_s", "hedge_ms", "restart_backoff_s",
+                     "restart_cap_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0, "
+                                 f"got {getattr(self, name)}")
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -106,6 +148,16 @@ class ServeConfig:
                                        cls.queue_timeout_s),
             host=os.environ.get("FF_SERVE_HOST", cls.host),
             port=_env_int("FF_SERVE_PORT", cls.port, lo=0),
+            replicas=_env_int("FF_SERVE_REPLICAS", cls.replicas),
+            max_queue=_env_int("FF_SERVE_MAX_QUEUE", cls.max_queue, lo=0),
+            shed_wait_s=_env_float("FF_SERVE_SHED_WAIT_S", cls.shed_wait_s),
+            replica_timeout_s=_env_float("FF_SERVE_REPLICA_TIMEOUT",
+                                         cls.replica_timeout_s),
+            hedge_ms=_env_float("FF_SERVE_HEDGE_MS", cls.hedge_ms),
+            restart_backoff_s=_env_float("FF_SERVE_RESTART_BACKOFF_S",
+                                         cls.restart_backoff_s),
+            restart_cap_s=_env_float("FF_SERVE_RESTART_CAP_S",
+                                     cls.restart_cap_s),
         )
         raw = os.environ.get("FF_SERVE_BUCKETS", "")
         if raw:
@@ -137,9 +189,36 @@ class ServeConfig:
                 return b
         return None
 
+    def validate_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Shape admission: raises ValueError when a request cannot fit
+        this config (shared by the engine and the replica pool so both
+        reject with the same message)."""
+        if max_new_tokens > self.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds the engine cap "
+                f"{self.max_new_tokens} (FF_SERVE_MAX_NEW_TOKENS)")
+        if self.bucket_for(prompt_len) is None:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the largest prefill "
+                f"bucket {self.resolved_buckets()[-1]} (FF_SERVE_BUCKETS)")
+        if prompt_len + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens})"
+                f" = {prompt_len + max_new_tokens} exceeds max_seq "
+                f"{self.max_seq} (FF_SERVE_MAX_SEQ)")
+
     def describe(self) -> str:
+        pool = ""
+        if self.replicas > 1 or self.max_queue or self.hedge_ms:
+            pool = (f" replicas={self.replicas} "
+                    f"max_queue={self.max_queue or 'inf'} "
+                    f"shed_wait={self.shed_wait_s:g}s "
+                    f"replica_timeout={self.replica_timeout_s:g}s "
+                    f"hedge={self.hedge_ms:g}ms "
+                    f"restart_backoff={self.restart_backoff_s:g}s"
+                    f"/{self.restart_cap_s:g}s")
         return (f"max_batch={self.max_batch} max_seq={self.max_seq} "
                 f"buckets={list(self.resolved_buckets())} "
                 f"max_new_tokens={self.max_new_tokens} "
                 f"queue_timeout={self.queue_timeout_s:g}s "
-                f"http={self.host}:{self.port}")
+                f"http={self.host}:{self.port}{pool}")

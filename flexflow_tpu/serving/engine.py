@@ -37,6 +37,7 @@ other request keep running.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -45,9 +46,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..testing.chaos import ChaosReplicaKill
 from .config import ServeConfig
 from .queue import (CANCELLED, DONE, ERROR, RUNNING, InferenceRequest,
                     RequestQueue, ServeError)
+
+_engine_uids = itertools.count(1)
 
 
 class _Slot:
@@ -77,12 +81,30 @@ class InferenceEngine:
     """
 
     def __init__(self, model, config: Optional[ServeConfig] = None,
-                 telemetry=None, **overrides):
+                 telemetry=None, queue: Optional[RequestQueue] = None,
+                 name: Optional[str] = None, decode_fatal: bool = False,
+                 **overrides):
         assert getattr(model, "_compiled", False), \
             "InferenceEngine needs a compiled model (call compile() first)"
         self.model = model
         self.config = config if config is not None \
             else ServeConfig.from_env(**overrides)
+        # replica-pool plumbing (inert for a standalone engine):
+        #  * ``queue`` — a SHARED admission queue owned by the pool; this
+        #    engine then never drains it (other replicas' requests live
+        #    there too),
+        #  * ``name`` — stable replica name for telemetry attribution,
+        #  * ``uid`` — per-INCARNATION key: failover re-dispatch marks a
+        #    request ``avoid=uid`` so the same incarnation cannot pop it
+        #    back, while a restarted replica (fresh uid) still can,
+        #  * ``decode_fatal`` — a decode-step exception propagates out of
+        #    the loop (the pool marks the replica UNHEALTHY and fails its
+        #    requests over) instead of failing the batch in place.
+        self.name = name or "replica-0"
+        self.uid = f"{self.name}#{next(_engine_uids)}"
+        self._decode_fatal = bool(decode_fatal)
+        self.crashed: Optional[str] = None   # set when the loop dies
+        self.last_beat = time.perf_counter()  # decode-progress heartbeat
         self._tok_t, self._pos_t = model.resolve_decode_inputs()
         fed = {self._tok_t.guid}
         if self._pos_t is not None:
@@ -100,7 +122,9 @@ class InferenceEngine:
         self._chaos = getattr(model, "_chaos", None)
 
         B = self.config.max_batch
-        self._queue = RequestQueue()
+        self._queue = queue if queue is not None else RequestQueue()
+        self._owns_queue = queue is None
+        self._admitting: Optional[InferenceRequest] = None
         self._slots: List[Optional[_Slot]] = [None] * B
         self._toks = np.zeros(B, np.int32)   # last fed token per slot
         self._pos = np.zeros(B, np.int32)    # its position per slot
@@ -190,10 +214,15 @@ class InferenceEngine:
         assert self._thread is None, "engine already started"
         self._stop_evt.clear()
         self._accepting = True
-        self._thread = threading.Thread(target=self._loop,
-                                        name="ff-serve-loop", daemon=True)
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"ff-serve-{self.name}",
+                                        daemon=True)
         self._thread.start()
         return self
+
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop the loop.  ``drain=True`` finishes queued + running
@@ -206,6 +235,28 @@ class InferenceEngine:
         if t is not None:
             t.join(timeout)
             self._thread = None
+
+    def abandon(self) -> None:
+        """Pool-side: detach this (crashed or wedged) incarnation
+        WITHOUT joining its thread — a thread sleeping inside an
+        injected hang may not wake for an hour, and it is a daemon.
+        The loop exits at its next conscious moment; any request it
+        still resolves afterwards loses the CAS against the pool's
+        failover and is ignored."""
+        self._accepting = False
+        self._drain = False
+        self._stop_evt.set()
+
+    def active_requests(self) -> List[InferenceRequest]:
+        """Unresolved requests this replica is holding: live decode
+        slots plus one possibly mid-admission (a replica killed between
+        pop and prefill must not lose that request).  Read by the pool's
+        monitor from another thread — a snapshot, not a lock."""
+        reqs = [s.req for s in self._slots if s is not None]
+        adm = self._admitting
+        if adm is not None and all(r is not adm for r in reqs):
+            reqs.append(adm)
+        return [r for r in reqs if not r.done()]
 
     def __enter__(self) -> "InferenceEngine":
         return self.start()
@@ -222,9 +273,6 @@ class InferenceEngine:
         cfg = self.config
         n = cfg.max_new_tokens if max_new_tokens is None \
             else int(max_new_tokens)
-        if n > cfg.max_new_tokens:
-            raise ValueError(f"max_new_tokens {n} exceeds the engine cap "
-                             f"{cfg.max_new_tokens} (FF_SERVE_MAX_NEW_TOKENS)")
         req = InferenceRequest(
             prompt, n, priority=priority, eos_id=eos_id,
             request_id=request_id,
@@ -232,15 +280,7 @@ class InferenceEngine:
             else timeout_s)
         if req.timeout_s == 0:
             req.timeout_s = None              # 0: wait forever
-        plen = int(req.prompt.size)
-        if cfg.bucket_for(plen) is None:
-            raise ValueError(
-                f"prompt length {plen} exceeds the largest prefill bucket "
-                f"{cfg.resolved_buckets()[-1]} (FF_SERVE_BUCKETS)")
-        if plen + n > cfg.max_seq:
-            raise ValueError(
-                f"prompt ({plen}) + max_new_tokens ({n}) = {plen + n} "
-                f"exceeds max_seq {cfg.max_seq} (FF_SERVE_MAX_SEQ)")
+        cfg.validate_request(int(req.prompt.size), n)
         if not self._accepting:
             raise ServeError("engine is not accepting requests "
                              "(not started, or stopping)")
@@ -272,10 +312,36 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # the loop (one background thread; all jax dispatch happens here)
     # ------------------------------------------------------------------
+    def _run(self) -> None:
+        """Thread body: the loop plus a crash recorder.  A loop that
+        dies (``decode_fatal``, ChaosReplicaKill, a bug) must leave a
+        diagnosis behind — a standalone engine fails its outstanding
+        requests so no caller blocks forever; a pool replica leaves them
+        UNRESOLVED for the pool's failover to re-enqueue."""
+        try:
+            self._loop()
+        except BaseException as e:  # noqa: BLE001 — read by the pool
+            self.crashed = f"{type(e).__name__}: {e}"
+            if self._telemetry is not None:
+                self._telemetry.event("serve_loop_crashed",
+                                      replica=self.name, error=self.crashed)
+                self._telemetry.flush()
+            if self._owns_queue:
+                self._fail_outstanding(f"engine crashed: {self.crashed}")
+
+    def _fail_outstanding(self, msg: str) -> None:
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                if slot.req._resolve(ERROR, msg):
+                    self._stats["failed"] += 1
+                    self._emit_done(slot.req)
+                self._slots[i] = None
+        self._stats["failed"] += self._queue.drain(ERROR, msg)
+
     def _loop(self) -> None:
         cfg = self.config
         while True:
-            now = time.perf_counter()
+            now = self.last_beat = time.perf_counter()
             self._stats["timeouts"] += self._queue.expire(now)
             if self._stop_evt.is_set():
                 if not self._drain:
@@ -284,16 +350,25 @@ class InferenceEngine:
                     break
             self._admit_ready(now)
             if self.num_active == 0:
-                if not self._stop_evt.is_set():
+                if len(self._queue):
+                    # nonempty but nothing admittable: every queued item
+                    # avoids THIS incarnation (failover/hedge targets) —
+                    # sleep instead of spinning on wait_nonempty
+                    time.sleep(cfg.poll_interval_s)
+                elif not self._stop_evt.is_set():
                     self._queue.wait_nonempty(cfg.poll_interval_s)
                 continue
             self._decode_iteration()
-        n = self._queue.drain(CANCELLED, "engine stopped")
-        self._stats["cancelled"] += n
+        # shutdown: a standalone engine owns its queue and cancels what
+        # is left; a pool replica must NOT drain the shared queue (other
+        # replicas' requests live there) — the pool drains it once
+        if self._owns_queue:
+            self._stats["cancelled"] += self._queue.drain(
+                CANCELLED, "engine stopped")
         for i, slot in enumerate(self._slots):
             if slot is not None:
-                slot.req._resolve(CANCELLED, "engine stopped")
-                self._stats["cancelled"] += 1
+                if slot.req._resolve(CANCELLED, "engine stopped"):
+                    self._stats["cancelled"] += 1
                 self._slots[i] = None
 
     def _admit_ready(self, now: float) -> None:
@@ -302,21 +377,29 @@ class InferenceEngine:
                          if s is None), None)
             if free is None:
                 return
-            req = self._queue.pop_ready(now)
+            req = self._queue.pop_ready(now, avoid_key=self.uid)
             if req is None:
                 return
+            self._admitting = req
             try:
                 self._admit(req, free)
+            except ChaosReplicaKill:
+                # replica-scoped fault: deliberately NOT isolated — the
+                # loop thread dies; ``_admitting`` stays set so the pool
+                # fails this request over with the in-flight ones
+                raise
             except Exception as e:  # noqa: BLE001 — isolate per request
                 req._resolve(ERROR, f"{type(e).__name__}: {e}")
                 self._stats["failed"] += 1
                 self._emit_done(req)
+            self._admitting = None
 
     def _admit(self, req: InferenceRequest, slot: int) -> None:
         """Prefill ``req`` into ``slot``; on return the slot is live and
         the request owns its first generated token."""
         self._admit_seq += 1
         req.admit_seq = self._admit_seq
+        req.admitted_by = self.uid
         if self._chaos is not None:
             # serve site: trigger = 1-based admission count; a raised
             # fault fails THIS request only (caught in _admit_ready)
@@ -349,7 +432,7 @@ class InferenceEngine:
                         request_id=req.request_id, priority=req.priority)
             log.span_at("serve_prefill", t0, t1 - t0,
                         request_id=req.request_id, prompt_len=plen,
-                        bucket=bucket, slot=slot)
+                        bucket=bucket, slot=slot, replica=self.name)
         if req.max_new_tokens == 1 or first_tok == req.eos_id:
             self._finish(req, slot=None, t_done=t1)
             return
@@ -371,7 +454,11 @@ class InferenceEngine:
             nxt = np.asarray(nxt)
         except Exception as e:  # noqa: BLE001 — a step fault kills the
             # BATCH's requests but never the loop: resolve them all and
-            # keep serving (fresh admissions re-prefill fresh caches)
+            # keep serving (fresh admissions re-prefill fresh caches).
+            # A pool replica (decode_fatal) instead lets it propagate —
+            # the in-flight requests stay UNRESOLVED for failover.
+            if self._decode_fatal:
+                raise
             msg = f"decode step failed: {type(e).__name__}: {e}"
             for i, slot in enumerate(self._slots):
                 if slot is not None:
@@ -385,9 +472,19 @@ class InferenceEngine:
         self._stats["step_iterations"] += 1
         self._stats["occupancy_sum"] += active
         if self._telemetry is not None:
-            self._telemetry.gauge("serve_batch_occupancy", active)
+            self._telemetry.gauge("serve_batch_occupancy", active,
+                                  replica=self.name)
         for i, slot in enumerate(self._slots):
             if slot is None:
+                continue
+            if slot.req.done():
+                # resolved externally mid-decode (hedge loser force-
+                # cancelled, pool shutdown): free the lane; the next
+                # admission overwrites its cache slice wholesale
+                self._slots[i] = None
+                self._toks[i] = 0
+                self._pos[i] = 0
+                self._stats["cancelled"] += 1
                 continue
             tok = int(nxt[i])
             slot.req.tokens.append(tok)
@@ -405,9 +502,9 @@ class InferenceEngine:
             self._toks[slot] = 0
             self._pos[slot] = 0
         req.t_done = t_done
-        req._resolve(DONE)
-        self._stats["completed"] += 1
-        self._stats["tokens_out"] += len(req.tokens)
+        if req._resolve(DONE):
+            self._stats["completed"] += 1
+            self._stats["tokens_out"] += len(req.tokens)
         self._emit_done(req)
 
     def _emit_done(self, req: InferenceRequest) -> None:
@@ -420,7 +517,7 @@ class InferenceEngine:
                         request_id=req.request_id, tokens=len(req.tokens))
         attrs = dict(request_id=req.request_id, status=req.status,
                      prompt_len=int(req.prompt.size),
-                     new_tokens=len(req.tokens))
+                     new_tokens=len(req.tokens), replica=self.name)
         for k in ("queue_wait_s", "ttft_s", "tpot_s"):
             v = getattr(req, k)
             if v is not None:

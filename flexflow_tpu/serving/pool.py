@@ -1,0 +1,594 @@
+"""Health-checked replica pool: N engines behind one admission queue.
+
+The continuous-batching ``InferenceEngine`` (engine.py) is a single
+point of failure: one wedged decode step or one poisoned slot pool
+takes the whole service down, and overload has no defined behavior
+beyond unbounded queue growth.  ``ReplicaPool`` is the robustness
+layer over it, following the TensorFlow fault-tolerance stance
+(PAPERS.md, arXiv 1605.08695): assume replicas FAIL, detect it with
+health checks, and recover by re-execution — never by preventing the
+failure.
+
+Architecture — the ATTEMPT-CLONE model::
+
+    caller ── submit() ──> client InferenceRequest  (never enqueued)
+                                │ 1..k attempts
+                                v
+            attempt InferenceRequest ("req-7#a1", "req-7#a2", ...)
+                                │  shared RequestQueue
+             ┌──────────────────┼──────────────────┐
+         replica-0          replica-1          replica-2
+        (own engine,       (own engine,       (own engine,
+         own jit fns,       own kv pool)       own kv pool)
+         own kv pool)
+
+    Each dispatch is a FRESH engine-level request; a done-callback
+    transfers the winning attempt's tokens/timestamps to the client via
+    the CAS in ``InferenceRequest._resolve``.  A wedged replica waking
+    up hours later and resolving its stale attempt simply LOSES the CAS
+    — the client can never be double-resolved, and failover/hedging
+    reduce to "make another attempt, first finisher wins".
+
+Replicas are thread-isolated on CPU (one shared compiled model — the
+jitted step is pure, params are read-only); on real hardware pass one
+model per disjoint device slice (``models=[m0, m1, ...]``) and each
+replica's engine, caches, and compiles live on its own slice.
+
+Health model (monitor thread):
+
+* every engine-loop iteration stamps ``engine.last_beat``; a beat older
+  than ``FF_SERVE_REPLICA_TIMEOUT`` means the loop is wedged (injected
+  ``replica_hang``, a stuck device transfer),
+* a loop that THROWS (``decode_fatal`` engines re-raise decode faults;
+  ``replica_kill`` propagates through admission) records
+  ``engine.crashed`` and dies.
+
+Either way the replica is marked down (``replica_down`` event), its
+engine is abandoned (never joined — the thread may be asleep inside an
+injected hang), its in-flight attempts are failed over (new attempts,
+``avoid`` = the dead incarnation's uid so only OTHER replicas — or a
+future restart of this one — can pop them, ``request_failover``
+events), and a restart is scheduled with the shared bounded exponential
+backoff (``runtime/resilience.backoff_delay``, ``replica_restart``
+event on success).
+
+Admission control (``submit``): with ``FF_SERVE_MAX_QUEUE`` set, a full
+queue sheds with ``ServeOverload`` (HTTP 503 + ``Retry-After`` from the
+estimated drain time); ``FF_SERVE_SHED_WAIT_S`` additionally sheds when
+the estimated wait alone is too long.  Hedging (``FF_SERVE_HEDGE_MS``):
+a request still unfinished that long after submit gets a second attempt
+on a different replica; the losing attempt is force-cancelled and its
+slot freed at the next token boundary.
+
+Graceful degradation: ``attach_preemption`` wires a PR-4
+``PreemptionHandler`` so SIGTERM drains the pool (finish everything
+admitted or queued, shed nothing mid-flight, ``pool_drain`` event).
+Losing replicas degrades THROUGHPUT only: greedy outputs are bitwise
+``FFModel.generate()`` regardless of which replica, restart, or
+failover served them, because every attempt prefills from scratch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..runtime.resilience import backoff_delay
+from .config import ServeConfig
+from .engine import InferenceEngine
+from .queue import (CANCELLED, DONE, InferenceRequest, RequestQueue,
+                    ServeError, ServeOverload)
+
+import numpy as np
+
+# replica states
+READY, RESTARTING, STOPPED = "ready", "restarting", "stopped"
+
+
+class _Replica:
+    """One replica slot: a stable name + the current engine incarnation
+    and its restart bookkeeping."""
+
+    __slots__ = ("name", "model", "engine", "state", "fails", "restarts",
+                 "restart_at", "failovers")
+
+    def __init__(self, name: str, model):
+        self.name = name
+        self.model = model
+        self.engine: Optional[InferenceEngine] = None
+        self.state = STOPPED
+        self.fails = 0           # consecutive down-marks (backoff input)
+        self.restarts = 0        # successful restarts
+        self.restart_at = 0.0
+        self.failovers = 0       # requests moved OFF this replica
+
+
+class _Client:
+    """Pool-side state of one client request."""
+
+    __slots__ = ("req", "attempts", "hedged", "n_attempts")
+
+    def __init__(self, req: InferenceRequest):
+        self.req = req
+        self.attempts: List[InferenceRequest] = []
+        self.hedged = False
+        self.n_attempts = 0
+
+
+class ReplicaPool:
+    """N ``InferenceEngine`` replicas behind one admission queue.
+
+    Usage::
+
+        pool = ReplicaPool(model, replicas=3, max_queue=64)
+        with pool:
+            h = pool.submit([1, 2, 3], max_new_tokens=16)
+            tokens = h.result(timeout=30)
+
+    ``models`` may be a single compiled model (replicated
+    ``config.replicas`` times, thread-isolated — the CPU/test shape) or
+    a sequence of models, one per disjoint device slice (the TPU shape;
+    ``replicas`` is then ``len(models)``).
+    """
+
+    def __init__(self, models, config: Optional[ServeConfig] = None,
+                 telemetry=None, **overrides):
+        self.config = config if config is not None \
+            else ServeConfig.from_env(**overrides)
+        if isinstance(models, (list, tuple)):
+            model_list: Sequence = list(models)
+        else:
+            model_list = [models] * self.config.replicas
+        if not model_list:
+            raise ValueError("ReplicaPool needs at least one model")
+        self._telemetry = telemetry if telemetry is not None \
+            else getattr(model_list[0], "_telemetry", None)
+
+        self._queue = RequestQueue()
+        self._replicas = [_Replica(f"replica-{i}", m)
+                          for i, m in enumerate(model_list)]
+        self._lock = threading.RLock()
+        self._clients: Dict[str, _Client] = {}    # client id -> state
+        self._attempts: Dict[str, _Client] = {}   # attempt id -> state
+        self._accepting = False
+        self._draining = False
+        self._stop_evt = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._preemption = None
+        self._svc_ewma: Optional[float] = None   # submit->done seconds
+        self._stats = dict(submitted=0, shed=0, hedged=0, failovers=0,
+                           completed=0, failed=0, timeouts=0, cancelled=0,
+                           replica_downs=0, replica_restarts=0)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "ReplicaPool":
+        assert self._monitor_thread is None, "pool already started"
+        for rep in self._replicas:
+            self._spawn_engine(rep)
+        self._accepting = True
+        self._stop_evt.clear()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, name="ff-pool-monitor", daemon=True)
+        self._monitor_thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 120.0) -> None:
+        """Stop the pool.  ``drain=True`` finishes everything admitted
+        or queued first (the SIGTERM path); ``drain=False`` cancels all
+        outstanding work."""
+        if drain:
+            self._begin_drain("stop")
+        else:
+            with self._lock:
+                self._accepting = False
+                self._draining = True
+            for rep in self._replicas:
+                if rep.engine is not None and rep.state == READY:
+                    rep.engine.stop(drain=False)
+                rep.state = STOPPED
+            self._queue.drain(CANCELLED, "pool stopped")
+            self._cancel_leftover("pool stopped")
+        self._stop_evt.set()
+        t = self._monitor_thread
+        if t is not None:
+            t.join(timeout)
+            self._monitor_thread = None
+
+    def __enter__(self) -> "ReplicaPool":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    def attach_preemption(self, handler) -> None:
+        """Wire a ``runtime.resilience.PreemptionHandler``: once its
+        flag is set (SIGTERM/SIGINT), the monitor drains the pool and
+        exits — in-flight and queued work completes, new submits are
+        refused."""
+        self._preemption = handler
+
+    def _spawn_engine(self, rep: _Replica) -> None:
+        rep.engine = InferenceEngine(
+            rep.model, config=self.config, telemetry=self._telemetry,
+            queue=self._queue, name=rep.name, decode_fatal=True)
+        rep.engine.start()
+        rep.state = READY
+
+    # ------------------------------------------------------------------
+    # submission (admission control lives here)
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
+               priority: int = 0, timeout_s: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               request_id: Optional[str] = None) -> InferenceRequest:
+        """Enqueue one prompt; returns the CLIENT request handle.
+        Raises ``ServeOverload`` (503 + Retry-After) when admission
+        control sheds, ``ValueError`` on shape problems, ``ServeError``
+        when the pool is not accepting."""
+        cfg = self.config
+        n = cfg.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        client = InferenceRequest(
+            prompt, n, priority=priority, eos_id=eos_id,
+            request_id=request_id,
+            timeout_s=cfg.queue_timeout_s if timeout_s is None
+            else timeout_s)
+        if client.timeout_s == 0:
+            client.timeout_s = None          # 0: wait forever
+        cfg.validate_request(int(client.prompt.size), n)
+        if not self._accepting:
+            raise ServeError("pool is not accepting requests "
+                             "(not started, draining, or stopped)")
+        self._check_admission()
+        st = _Client(client)
+        with self._lock:
+            self._stats["submitted"] += 1
+            self._clients[client.request_id] = st
+            client.add_done_callback(
+                lambda r, st=st: self._on_client_done(st, r))
+            self._dispatch(st, first=True)
+        return client
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = None, **kw) -> np.ndarray:
+        """Synchronous convenience: submit + result."""
+        return self.submit(prompt, max_new_tokens, **kw).result(timeout)
+
+    def _check_admission(self) -> None:
+        """Count- and estimated-wait-based load shedding."""
+        cfg = self.config
+        if not cfg.max_queue and not cfg.shed_wait_s:
+            return
+        qlen = len(self._queue)
+        ready = sum(r.state == READY for r in self._replicas)
+        svc = self._svc_ewma if self._svc_ewma is not None else 0.1
+        capacity = max(1, ready) * cfg.max_batch
+        est_wait = (qlen + 1) * svc / capacity
+        reason = None
+        if cfg.max_queue and qlen >= cfg.max_queue:
+            reason = (f"queue full ({qlen} >= FF_SERVE_MAX_QUEUE="
+                      f"{cfg.max_queue})")
+        elif cfg.shed_wait_s and est_wait > cfg.shed_wait_s:
+            reason = (f"estimated wait {est_wait:.2f}s exceeds "
+                      f"FF_SERVE_SHED_WAIT_S={cfg.shed_wait_s:g}")
+        if reason is None:
+            return
+        self._stats["shed"] += 1
+        log = self._telemetry
+        if log is not None:
+            log.event("request_shed", reason=reason, queued=qlen,
+                      ready_replicas=ready,
+                      retry_after_s=round(est_wait, 3))
+            log.counter("serve_shed", 1)
+            log.flush()
+        raise ServeOverload(f"overloaded: {reason}",
+                            retry_after_s=est_wait)
+
+    # ------------------------------------------------------------------
+    # attempts (dispatch, transfer, failover, hedge)
+    # ------------------------------------------------------------------
+    def _dispatch(self, st: _Client, first: bool = False,
+                  avoid: Optional[str] = None) -> InferenceRequest:
+        """Create + enqueue one attempt for ``st`` (pool lock held).
+        Only the FIRST attempt carries the admission timeout — a
+        failover/hedge attempt already won admission once and must not
+        instant-expire against the original submit clock."""
+        c = st.req
+        st.n_attempts += 1
+        att = InferenceRequest(
+            c.prompt, c.max_new_tokens, priority=c.priority,
+            eos_id=c.eos_id,
+            request_id=f"{c.request_id}#a{st.n_attempts}",
+            timeout_s=c.timeout_s if first else None)
+        now = time.perf_counter()
+        if c.t_submit is None:
+            c.t_submit = now
+        att.t_submit = c.t_submit    # queue-wait stays the CALLER's clock
+        att.avoid = avoid
+        st.attempts.append(att)
+        self._attempts[att.request_id] = st
+        att.add_done_callback(
+            lambda a, st=st: self._on_attempt_done(st, a))
+        self._queue.put(att)
+        return att
+
+    def _on_attempt_done(self, st: _Client, att: InferenceRequest) -> None:
+        """An attempt resolved (any thread).  Tracked attempts transfer
+        their outcome to the client; anything already untracked is a
+        stale incarnation artifact and is ignored."""
+        with self._lock:
+            if all(a is not att for a in st.attempts):
+                return
+            st.attempts.remove(att)
+            self._attempts.pop(att.request_id, None)
+            c = st.req
+            if att.status == DONE:
+                self._note_service_time(att)
+                if not c.done():
+                    # copy BEFORE the CAS: once resolved, readers may
+                    # look at tokens/timestamps at any moment
+                    c.tokens = list(att.tokens)
+                    c.t_admit = att.t_admit
+                    c.t_first = att.t_first
+                    c.t_done = att.t_done
+                    c.admitted_by = att.admitted_by
+                c._resolve(DONE)
+                return
+            if st.attempts:
+                # a sibling attempt (hedge) is still in flight — let it
+                # decide the client's fate
+                return
+            c.error = att.error
+            c._resolve(att.status, att.error)
+
+    def _on_client_done(self, st: _Client, req: InferenceRequest) -> None:
+        """Client resolved (transfer, shed, cancel, drain): cancel any
+        attempt still in flight — force, so a hedge loser's decode slot
+        frees at the next token boundary — and drop the state."""
+        with self._lock:
+            atts, st.attempts = st.attempts, []
+            for a in atts:
+                self._attempts.pop(a.request_id, None)
+            self._clients.pop(req.request_id, None)
+            key = {DONE: "completed", CANCELLED: "cancelled",
+                   "timeout": "timeouts"}.get(req.status, "failed")
+            self._stats[key] += 1
+        for a in atts:
+            a.cancel("client resolved", force=True)
+
+    def _note_service_time(self, att: InferenceRequest) -> None:
+        if att.t_submit is None or att.t_done is None:
+            return
+        dt = att.t_done - att.t_submit
+        self._svc_ewma = dt if self._svc_ewma is None \
+            else 0.8 * self._svc_ewma + 0.2 * dt
+
+    def _fail_over(self, rep: _Replica, reason: str) -> int:
+        """Move a down replica's in-flight attempts to survivors."""
+        eng = rep.engine
+        eng.abandon()
+        moved = 0
+        for att in eng.active_requests():
+            with self._lock:
+                st = self._attempts.get(att.request_id)
+                if st is None or st.req.done() \
+                        or all(a is not att for a in st.attempts):
+                    continue
+                st.attempts.remove(att)
+                self._attempts.pop(att.request_id, None)
+                new = self._dispatch(st, avoid=eng.uid)
+            # cancel AFTER untracking: the dead incarnation waking up
+            # and resolving the old attempt is now a guaranteed no-op
+            att.cancel(f"failover: {reason}", force=True)
+            moved += 1
+            rep.failovers += 1
+            self._stats["failovers"] += 1
+            log = self._telemetry
+            if log is not None:
+                log.event("request_failover",
+                          request_id=st.req.request_id,
+                          from_replica=rep.name, attempt=new.request_id,
+                          reason=reason)
+                log.counter("serve_failovers", 1)
+        if self._telemetry is not None:
+            self._telemetry.flush()
+        return moved
+
+    # ------------------------------------------------------------------
+    # the monitor (health checks, restarts, hedging, preemption)
+    # ------------------------------------------------------------------
+    def _monitor_interval(self) -> float:
+        cfg = self.config
+        iv = min(0.05, cfg.replica_timeout_s / 4.0)
+        if cfg.hedge_ms:
+            iv = min(iv, cfg.hedge_ms / 4000.0)
+        return max(iv, 0.005)
+
+    def _monitor(self) -> None:
+        cfg = self.config
+        iv = self._monitor_interval()
+        while not self._stop_evt.wait(iv):
+            if self._preemption is not None and self._preemption.requested \
+                    and not self._draining:
+                self._begin_drain(f"signal {self._preemption.signum}")
+                break
+            now = time.perf_counter()
+            for rep in self._replicas:
+                if rep.state == READY:
+                    bad = self._diagnose(rep.engine, now)
+                    if bad is not None:
+                        self._mark_down(rep, bad, now)
+                elif rep.state == RESTARTING and now >= rep.restart_at:
+                    self._restart(rep)
+            if cfg.hedge_ms:
+                self._hedge_scan(now)
+
+    def _diagnose(self, eng: InferenceEngine, now: float) -> Optional[str]:
+        if eng.crashed is not None:
+            return f"loop crashed: {eng.crashed}"
+        if not eng.alive():
+            return "loop thread exited"
+        stale = now - eng.last_beat
+        if stale > self.config.replica_timeout_s:
+            return (f"no decode progress for {stale:.1f}s "
+                    f"(FF_SERVE_REPLICA_TIMEOUT="
+                    f"{self.config.replica_timeout_s:g})")
+        return None
+
+    def _mark_down(self, rep: _Replica, reason: str, now: float) -> None:
+        rep.state = RESTARTING
+        rep.fails += 1
+        delay = backoff_delay(rep.fails, self.config.restart_backoff_s,
+                              self.config.restart_cap_s)
+        rep.restart_at = now + delay
+        self._stats["replica_downs"] += 1
+        log = self._telemetry
+        if log is not None:
+            log.event("replica_down", replica=rep.name,
+                      incarnation=rep.engine.uid, reason=reason,
+                      consecutive_fails=rep.fails,
+                      restart_in_s=round(delay, 3))
+            log.flush()
+        self._fail_over(rep, reason)
+
+    def _restart(self, rep: _Replica) -> None:
+        try:
+            self._spawn_engine(rep)
+        except Exception as e:  # noqa: BLE001 — count it as another fail
+            rep.state = RESTARTING
+            rep.fails += 1
+            rep.restart_at = time.perf_counter() + backoff_delay(
+                rep.fails, self.config.restart_backoff_s,
+                self.config.restart_cap_s)
+            if self._telemetry is not None:
+                self._telemetry.event(
+                    "replica_restart_failed", replica=rep.name,
+                    error=f"{type(e).__name__}: {e}")
+                self._telemetry.flush()
+            return
+        rep.restarts += 1
+        self._stats["replica_restarts"] += 1
+        log = self._telemetry
+        if log is not None:
+            log.event("replica_restart", replica=rep.name,
+                      incarnation=rep.engine.uid, restarts=rep.restarts)
+            log.flush()
+
+    def _hedge_scan(self, now: float) -> None:
+        cfg = self.config
+        with self._lock:
+            if sum(r.state == READY for r in self._replicas) < 2:
+                return
+            for st in list(self._clients.values()):
+                c = st.req
+                if st.hedged or c.done() or len(st.attempts) != 1:
+                    continue
+                att = st.attempts[0]
+                if att.t_admit is None:
+                    continue    # still queued: a second copy won't help
+                if c.t_submit is None \
+                        or (now - c.t_submit) * 1000.0 < cfg.hedge_ms:
+                    continue
+                st.hedged = True
+                self._stats["hedged"] += 1
+                second = self._dispatch(st, avoid=att.admitted_by)
+                log = self._telemetry
+                if log is not None:
+                    log.event("request_hedged",
+                              request_id=c.request_id,
+                              first_attempt=att.request_id,
+                              hedge_attempt=second.request_id,
+                              age_ms=round((now - c.t_submit) * 1000, 1))
+                    log.counter("serve_hedged", 1)
+                    log.flush()
+
+    # ------------------------------------------------------------------
+    # drain
+    # ------------------------------------------------------------------
+    def _begin_drain(self, reason: str) -> None:
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self._accepting = False
+        log = self._telemetry
+        if log is not None:
+            log.event("pool_drain", reason=reason,
+                      queued=len(self._queue),
+                      inflight=len(self._clients))
+            log.flush()
+        for rep in self._replicas:
+            if rep.engine is not None and rep.state == READY:
+                rep.engine.stop(drain=True)
+            rep.state = STOPPED
+        # anything still queued could only be served by replicas that no
+        # longer exist (all down, or died mid-drain): release the callers
+        self._queue.drain(CANCELLED, "pool drained")
+        self._cancel_leftover("pool drained")
+
+    def _cancel_leftover(self, reason: str) -> None:
+        with self._lock:
+            leftovers = [st.req for st in self._clients.values()]
+        for c in leftovers:
+            c.cancel(reason, force=True)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def num_queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def num_inflight(self) -> int:
+        with self._lock:
+            return len(self._clients)
+
+    def ready(self) -> bool:
+        """Readiness: accepting AND at least one replica can serve."""
+        return self._accepting \
+            and any(r.state == READY for r in self._replicas)
+
+    def healthz(self) -> Dict[str, Any]:
+        """Liveness detail (the HTTP ``/healthz`` body)."""
+        now = time.perf_counter()
+        reps = []
+        for r in self._replicas:
+            e = r.engine
+            reps.append(dict(
+                name=r.name, state=r.state,
+                incarnation=e.uid if e is not None else None,
+                beat_age_s=round(now - e.last_beat, 3)
+                if e is not None else None,
+                active=e.num_active if e is not None else 0,
+                fails=r.fails, restarts=r.restarts,
+                failovers=r.failovers))
+        any_ready = any(r["state"] == READY for r in reps)
+        if self._draining:
+            status = "draining" if any_ready else "stopped"
+        else:
+            status = "ok" if any_ready else "down"
+        return dict(status=status, accepting=self._accepting,
+                    queued=len(self._queue),
+                    inflight=self.num_inflight, replicas=reps)
+
+    def stats(self) -> Dict[str, Any]:
+        s = dict(self._stats)
+        s["queued"] = len(self._queue)
+        s["inflight"] = self.num_inflight
+        s["ready_replicas"] = sum(
+            r.state == READY for r in self._replicas)
+        s["replicas"] = {
+            r.name: dict(state=r.state, fails=r.fails,
+                         restarts=r.restarts, failovers=r.failovers,
+                         engine=r.engine.stats()
+                         if r.engine is not None else {})
+            for r in self._replicas}
+        return s

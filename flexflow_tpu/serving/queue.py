@@ -35,6 +35,16 @@ class ServeTimeout(TimeoutError):
     """The request expired waiting for admission (``timeout_s``)."""
 
 
+class ServeOverload(ServeError):
+    """Admission control shed this request (queue full / estimated wait
+    too long).  ``retry_after_s`` is the server's drain estimate — the
+    HTTP layer forwards it as a 503 ``Retry-After`` header."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(1.0, float(retry_after_s))
+
+
 _req_ids = itertools.count(1)
 
 
@@ -71,7 +81,14 @@ class InferenceRequest:
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
         self.admit_seq: Optional[int] = None  # engine admission order
+        # replica-pool fields: ``avoid`` names an engine uid that must
+        # NOT pop this request (hedge/failover re-dispatch targets a
+        # different replica); ``admitted_by`` is stamped at admission
+        self.avoid: Optional[str] = None
+        self.admitted_by: Optional[str] = None
         self._event = threading.Event()
+        self._rlock = threading.RLock()   # guards the resolve CAS
+        self._callbacks: List = []
 
     # -- metrics (valid once resolved) ----------------------------------
     @property
@@ -99,18 +116,57 @@ class InferenceRequest:
     def done(self) -> bool:
         return self._event.is_set()
 
-    def _resolve(self, status: str, error: Optional[str] = None) -> None:
-        self.status = status
-        self.error = error
-        if self.t_done is None:
-            self.t_done = time.perf_counter()
-        self._event.set()
+    def add_done_callback(self, fn) -> None:
+        """``fn(req)`` runs exactly once, after resolution (immediately
+        if already resolved).  Callbacks fire OUTSIDE the request lock,
+        on whichever thread resolves the request."""
+        with self._rlock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self, status: str, error: Optional[str] = None) -> bool:
+        """Compare-and-swap resolution: exactly one caller wins; every
+        later attempt (a failed-over replica waking up, a hedge loser, a
+        second expiry sweep) is a no-op.  Returns True iff this call
+        resolved the request."""
+        with self._rlock:
+            if self._event.is_set():
+                return False
+            self.status = status
+            self.error = error
+            if self.t_done is None:
+                self.t_done = time.perf_counter()
+            cbs, self._callbacks = self._callbacks, []
+            self._event.set()
+        for cb in cbs:
+            cb(self)
+        return True
+
+    def cancel(self, reason: str = "cancelled",
+               force: bool = False) -> bool:
+        """CAS to CANCELLED.  By default a no-op when the request is
+        already RUNNING (mid-decode work is left to finish — the caller
+        abandoned it, the engine did not); ``force=True`` cancels a
+        running request too (hedge losers, pool shutdown) — the engine
+        releases the slot at the next token boundary."""
+        with self._rlock:
+            if self._event.is_set():
+                return False
+            if self.status == RUNNING and not force:
+                return False
+            return self._resolve(CANCELLED, reason)
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until resolved; the greedy continuation as (N,) int32.
         Raises ServeTimeout (queue-wait expiry) or ServeError (engine
-        failure / shutdown)."""
+        failure / shutdown).  A caller giving up (``timeout`` elapsed)
+        CANCELS a still-queued request so abandoned work can never
+        occupy a decode slot; a request already running is left to
+        finish (its tokens are already half-paid-for)."""
         if not self._event.wait(timeout):
+            self.cancel("caller gave up waiting")
             raise ServeTimeout(
                 f"{self.request_id}: no result after {timeout}s")
         if self.status == TIMEOUT:
@@ -137,52 +193,83 @@ class RequestQueue:
             return len(self._heap)
 
     def put(self, req: InferenceRequest) -> None:
-        req.t_submit = time.perf_counter()
+        """Enqueue (or RE-enqueue: a failover/hedge attempt keeps its
+        original ``t_submit`` so queue-wait metrics and the admission
+        timeout stay truthful to the caller's clock)."""
+        now = time.perf_counter()
+        if req.t_submit is None:
+            req.t_submit = now
         with self._nonempty:
             heapq.heappush(self._heap, (-req.priority, next(self._seq), req))
             self._nonempty.notify_all()
+        # sweep on the put path too: an idle queue must not hold a dead
+        # request's caller hostage until somebody pops
+        self.expire(now)
 
-    def pop_ready(self, now: float) -> Optional[InferenceRequest]:
+    def pop_ready(self, now: float,
+                  avoid_key: Optional[str] = None) -> Optional[InferenceRequest]:
         """Highest-priority live request, resolving any expired ones
-        encountered on the way (their callers unblock with TIMEOUT)."""
+        encountered on the way (their callers unblock with TIMEOUT).
+        Requests already resolved externally (caller cancel, hedge
+        winner) are dropped; requests whose ``avoid`` matches
+        ``avoid_key`` are left queued for a DIFFERENT replica."""
+        expired: List[InferenceRequest] = []
+        skipped: List = []
+        got: Optional[InferenceRequest] = None
         with self._lock:
             while self._heap:
-                _, _, req = heapq.heappop(self._heap)
-                if self._expired(req, now):
-                    req._resolve(TIMEOUT)
+                entry = heapq.heappop(self._heap)
+                req = entry[2]
+                if req.done():
                     continue
-                return req
-        return None
+                if self._expired(req, now):
+                    expired.append(req)
+                    continue
+                if avoid_key is not None and req.avoid == avoid_key:
+                    skipped.append(entry)
+                    continue
+                got = req
+                break
+            for entry in skipped:
+                heapq.heappush(self._heap, entry)
+        for req in expired:     # resolve OUTSIDE the lock: callbacks
+            req._resolve(TIMEOUT)
+        return got
 
     def expire(self, now: float) -> int:
         """Resolve every expired queued request (runs at each token
         boundary so a backlogged request times out even while the
         batch is full and nothing is being popped)."""
-        n = 0
+        expired: List[InferenceRequest] = []
         with self._lock:
             live = []
             for entry in self._heap:
                 if self._expired(entry[2], now):
-                    entry[2]._resolve(TIMEOUT)
-                    n += 1
+                    expired.append(entry[2])
                 else:
                     live.append(entry)
-            if n:
+            if expired:
                 heapq.heapify(live)
                 self._heap = live
+        n = 0
+        for req in expired:     # outside the lock: callbacks may re-lock
+            n += bool(req._resolve(TIMEOUT))
         return n
 
     def drain(self, status: str = CANCELLED,
               error: Optional[str] = None) -> int:
         """Resolve everything still queued (engine shutdown)."""
         with self._lock:
-            n = len(self._heap)
-            for _, _, req in self._heap:
-                req._resolve(status, error)
-            self._heap = []
+            entries, self._heap = self._heap, []
+        n = 0
+        for _, _, req in entries:
+            n += bool(req._resolve(status, error))
         return n
 
     def wait_nonempty(self, timeout: float) -> bool:
+        # sweep BEFORE blocking: a request whose deadline passed while
+        # the queue sat idle is released here, not at the next put/pop
+        self.expire(time.perf_counter())
         with self._nonempty:
             if self._heap:
                 return True

@@ -39,6 +39,13 @@ Spec grammar (``FF_CHAOS`` environment variable)::
                               perf regression for probation/rollback and
                               sim-divergence tests; persistent, not
                               one-shot
+               | "replica_kill"  (serve site) raise ChaosReplicaKill out
+                              of the admitting engine's decode loop —
+                              the replica crashes; the pool fails over
+               | "replica_hang"  (serve site) wedge the admitting
+                              replica's loop thread for ``arg`` seconds
+                              (default 3600) so the pool's heartbeat
+                              monitor declares it stalled
     arg        = FLOAT        fault parameter (hang seconds, lost/regained
                               device count, per-step inflation seconds)
 
@@ -55,7 +62,17 @@ choke point (trigger = 1-based admission count), before the prefill —
 so ``serve:2=error`` fails exactly the second admitted request, which
 must NOT kill the batch loop or any other request (the engine's
 per-request error isolation, tests/test_serving.py); ``serve:3=hang:2``
-wedges the loop thread for 2s, stalling every in-flight request.
+wedges the loop thread for 2s, stalling every in-flight request.  Two
+faults target the REPLICA, not the request: ``serve:3=replica_kill``
+throws ``ChaosReplicaKill`` out of the admitting engine's decode loop —
+the whole replica crashes, the pool marks it UNHEALTHY, fails its
+in-flight requests over to survivors, and restarts it with backoff;
+``serve:3=replica_hang:5`` wedges the replica's loop thread for 5s so
+the pool's heartbeat monitor (``FF_SERVE_REPLICA_TIMEOUT``) declares it
+stalled.  Under a pool the admission counter is SHARED across replicas
+(the monkey serializes ``fire`` with a lock), so triggers stay a
+deterministic 1-based admission count regardless of which replica
+admits.
 
 The ``resharding`` site fires from the reconfiguration controller's
 per-step-boundary hook (``runtime/reconfigure.py``), with the GLOBAL
@@ -86,6 +103,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
@@ -93,7 +111,8 @@ from typing import Any, Dict, List, Optional, Tuple
 SITES = ("step", "data", "ckpt_save", "ckpt_restore", "sync", "serve",
          "resharding")
 FAULTS = ("nan_loss", "hang", "io_error", "sigterm", "sigint", "error",
-         "device_loss", "device_gain", "divergence")
+         "device_loss", "device_gain", "divergence",
+         "replica_kill", "replica_hang")
 
 
 class ChaosError(RuntimeError):
@@ -104,6 +123,13 @@ class ChaosIOError(OSError):
     """Injected I/O failure (``fault=io_error``) — an OSError so the
     checkpoint retry wrapper treats it exactly like a real filesystem
     error."""
+
+
+class ChaosReplicaKill(RuntimeError):
+    """Injected replica crash (``fault=replica_kill``).  The serving
+    engine deliberately does NOT isolate this one per-request: it
+    propagates out of the decode loop, the replica thread dies, and the
+    pool's health monitor must notice and fail over."""
 
 
 def parse_spec(spec: str) -> Tuple[Dict[Tuple[str, int], Tuple[str, Optional[float]]],
@@ -188,6 +214,10 @@ class ChaosMonkey:
         self.seed = int(seed)
         self._exact, self._prob = parse_spec(spec)
         self._counts: Dict[str, int] = {}
+        # replica-pool engines fire the shared ``serve`` counter from N
+        # loop threads; the lock keeps counts and exact-pops atomic
+        # (single-threaded sites pay one uncontended acquire)
+        self._lock = threading.Lock()
         self.fired: List[Tuple[str, int, str]] = []  # (site, trigger, fault)
         # resharding-site state, read by the reconfiguration controller
         self.lost_device_count = 0
@@ -211,21 +241,22 @@ class ChaosMonkey:
             # a previously fired ``divergence`` fault: every step pays
             # the planted inflation from here on
             time.sleep(self.inflate_step_s)
-        if index is None:
-            idx = self._counts.get(site, 0) + 1
-            self._counts[site] = idx
-        else:
-            idx = int(index)
-        hit = self._exact.pop((site, idx), None)
-        if hit is None:
-            for (s, p, fault, arg) in self._prob:
-                if s == site and _uniform(self.seed, site, idx) < p:
-                    hit = (fault, arg)
-                    break
-        if hit is None:
-            return None
-        fault, arg = hit
-        self.fired.append((site, idx, fault))
+        with self._lock:
+            if index is None:
+                idx = self._counts.get(site, 0) + 1
+                self._counts[site] = idx
+            else:
+                idx = int(index)
+            hit = self._exact.pop((site, idx), None)
+            if hit is None:
+                for (s, p, fault, arg) in self._prob:
+                    if s == site and _uniform(self.seed, site, idx) < p:
+                        hit = (fault, arg)
+                        break
+            if hit is None:
+                return None
+            fault, arg = hit
+            self.fired.append((site, idx, fault))
         self._emit(model, site, idx, fault)
         self._execute(fault, arg, site, idx, model)
         return fault
@@ -265,6 +296,11 @@ class ChaosMonkey:
                 0, self.lost_device_count - (int(arg) if arg else 1))
         elif fault == "divergence":
             self.inflate_step_s = float(arg) if arg is not None else 0.05
+        elif fault == "replica_kill":
+            raise ChaosReplicaKill(
+                f"chaos-injected replica crash at {where}")
+        elif fault == "replica_hang":
+            time.sleep(arg if arg is not None else 3600.0)
 
     @staticmethod
     def _poison_batch(model, where: str) -> None:

@@ -1,6 +1,6 @@
 """Chaos smoke: the acceptance scenarios for the robustness layer, as a CLI.
 
-Two scenarios, selected with ``--scenario``:
+Three scenarios, selected with ``--scenario``:
 
 ``recovery`` (default) — one seeded ``FF_CHAOS`` run injects a NaN step,
 a mid-epoch SIGTERM, and a failing checkpoint write; the resumed run
@@ -16,12 +16,21 @@ degraded mesh, and leave a diffable pair of swap ``.pb`` records behind.
 Two independent runs must produce bitwise-identical parameters — the
 failover itself is deterministic.
 
+``serve_failover`` — a chaos-injected replica crash (``replica_kill``)
+in a 3-replica ``ReplicaPool`` mid-load; every request — including the
+ones in flight on the killed replica — must still complete with tokens
+BITWISE-equal to one-shot ``FFModel.generate()``, the monitor must
+restart the dead replica, the trace must narrate the lifecycle
+(``replica_down`` / ``request_failover`` / ``replica_restart``), and
+the goodput headline lands in ``BENCH_SERVE.json``.
+
 Run by ``test.sh``; also a handy pod-shell sanity check after touching
 the robustness layer.
 
 Usage:
     python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/chaos
     python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/rs --scenario reshard
+    python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/sf --scenario serve_failover
 """
 
 from __future__ import annotations
@@ -78,13 +87,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--workdir", required=True,
                    help="scratch dir for checkpoints + traces")
-    p.add_argument("--scenario", choices=("recovery", "reshard"),
+    p.add_argument("--scenario",
+                   choices=("recovery", "reshard", "serve_failover"),
                    default="recovery",
                    help="recovery = NaN/SIGTERM/io_error resume drill; "
-                        "reshard = chaos device loss + hot-swap failover")
+                        "reshard = chaos device loss + hot-swap failover; "
+                        "serve_failover = replica kill in a serving pool")
     args = p.parse_args(argv)
     os.makedirs(args.workdir, exist_ok=True)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.scenario == "serve_failover":
+        return _scenario_serve_failover(args.workdir)
     if args.scenario == "reshard":
         # the failover drill needs a mesh to shrink — must be set before
         # the first jax import touches the backend
@@ -218,6 +231,110 @@ def _scenario_reshard(wd: str) -> int:
     print(f"run2: swap at step {swap2['step']}, params bitwise-equal "
           "to run1", flush=True)
     print("RESHARD SMOKE OK")
+    return 0
+
+
+def _build_serve_model():
+    """Tiny decoder transformer — same shape tests/test_serving.py uses,
+    so greedy equivalence is checked against a known-good path."""
+    import flexflow_tpu as ff
+    from ..models.transformer import build_transformer
+
+    cfg = ff.FFConfig(batch_size=4)
+    m = ff.FFModel(cfg)
+    build_transformer(m, 4, seq_length=64, num_layers=1,
+                      embed_dim=16, num_heads=2, vocab_size=32)
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=3)
+    return m
+
+
+def _scenario_serve_failover(wd: str) -> int:
+    import time
+
+    import numpy as np
+
+    from ..observability import events
+    from ..serving import ServeConfig
+    from ..serving.pool import ReplicaPool
+
+    NEW = 8        # tokens per request
+    N_REQ = 10
+    trace = os.path.join(wd, "serve_trace.jsonl")
+    # 5th pool-wide admission raises ChaosReplicaKill inside one
+    # replica's decode loop: that thread dies with a request mid-admit
+    # and (max_batch=2) possibly one more in a live slot
+    _phase({"FF_CHAOS": "serve:5=replica_kill", "FF_TELEMETRY": "1",
+            "FF_TELEMETRY_FILE": trace})
+    m = _build_serve_model()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 32, size=int(rng.integers(3, 11)))
+               .astype(np.int32) for _ in range(N_REQ)]
+    # ground truth first: the chaos spec only matches the serve site,
+    # so one-shot generate() is uninstrumented (and warms the compiles)
+    want = [m.generate(p[None], NEW)[0] for p in prompts]
+
+    cfg = ServeConfig(max_batch=2, max_seq=64, max_new_tokens=NEW,
+                      replicas=3, replica_timeout_s=120.0,
+                      restart_backoff_s=0.05, restart_cap_s=0.2)
+    pool = ReplicaPool(m, config=cfg)
+    pool.start()
+    t0 = time.perf_counter()
+    reqs = [pool.submit(p, NEW) for p in prompts]
+    outs = [r.result(180) for r in reqs]
+    wall = time.perf_counter() - t0
+
+    # every request — the queued ones AND the in-flight ones on the
+    # killed replica — completed bitwise-equal to the single-engine path
+    bad = [i for i, (got, w) in enumerate(zip(outs, want))
+           if not np.array_equal(np.asarray(got, np.int32), w)]
+    assert not bad, f"failover broke greedy equivalence for {bad}"
+    st = pool.stats()
+    assert st["replica_downs"] >= 1, f"chaos kill never landed: {st}"
+    assert st["failovers"] >= 1, \
+        f"no in-flight request failed over: {st}"
+
+    # the monitor must bring the dead replica back (backoff is tiny)
+    deadline = time.perf_counter() + 30
+    while time.perf_counter() < deadline:
+        hz = pool.healthz()
+        if (pool.stats()["replica_restarts"] >= 1
+                and all(r["state"] == "ready" for r in hz["replicas"])):
+            break
+        time.sleep(0.05)
+    hz = pool.healthz()
+    assert pool.stats()["replica_restarts"] >= 1, pool.stats()
+    assert all(r["state"] == "ready" for r in hz["replicas"]), hz
+    st = pool.stats()
+    pool.stop()
+    events.reset_active()
+    print(f"pool: {st['completed']}/{N_REQ} completed bitwise-equal · "
+          f"{st['replica_downs']} down, {st['failovers']} failovers, "
+          f"{st['replica_restarts']} restarts", flush=True)
+
+    # the trace narrates the whole replica lifecycle
+    names = [json.loads(l).get("name") for l in open(trace) if l.strip()]
+    for ev in ("replica_down", "request_failover", "replica_restart"):
+        assert ev in names, f"{ev} missing from trace (saw {set(names)})"
+    print(f"trace: replica lifecycle narrated ({trace})", flush=True)
+
+    # goodput headline, same schema corner loadgen writes
+    bench = {"bench": "serve_failover_smoke", "requests": N_REQ,
+             "replicas": 3, "n_ok": len(outs), "n_fail": 0,
+             "wall_s": round(wall, 3),
+             "goodput_rps": round(len(outs) / wall, 3) if wall > 0
+             else 0.0,
+             "pool": {k: st[k] for k in
+                      ("completed", "failovers", "replica_downs",
+                       "replica_restarts", "shed", "hedged")}}
+    out = os.path.join(wd, "BENCH_SERVE.json")
+    with open(out, "w") as f:
+        json.dump(bench, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"bench: goodput {bench['goodput_rps']:.2f} req/s -> {out}",
+          flush=True)
+    print("SERVE FAILOVER SMOKE OK")
     return 0
 
 

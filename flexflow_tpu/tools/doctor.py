@@ -183,7 +183,16 @@ def _serving():
     from ..serving.config import ServeConfig
 
     cfg = ServeConfig.from_env()  # ValueError on a typo'd env var
+    # (this parses + range-checks every replica-pool knob too:
+    # FF_SERVE_REPLICAS/MAX_QUEUE/SHED_WAIT_S/REPLICA_TIMEOUT/HEDGE_MS/
+    # RESTART_BACKOFF_S/RESTART_CAP_S)
     bits = [cfg.describe()]
+    if cfg.hedge_ms and cfg.replicas < 2:
+        bits.append("WARN: FF_SERVE_HEDGE_MS set but FF_SERVE_REPLICAS<2 "
+                    "— hedging needs a second replica (inert)")
+    if cfg.restart_backoff_s > cfg.restart_cap_s > 0:
+        bits.append("WARN: FF_SERVE_RESTART_BACKOFF_S exceeds "
+                    "FF_SERVE_RESTART_CAP_S (every restart waits the cap)")
     probe_port = cfg.port if os.environ.get("FF_SERVE_PORT") else 0
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     try:

@@ -15,7 +15,23 @@ Modes:
   open              requests arrive on a seeded Poisson clock at
                     ``--rate`` req/s regardless of completions (measures
                     latency under offered load; backlog grows if the
-                    engine can't keep up)
+                    engine can't keep up).  ``--arrival-trace FILE``
+                    replays explicit arrival offsets (one float seconds
+                    per line, or a JSON list) instead of the Poisson
+                    clock; either way the offsets used are recorded in
+                    ``BENCH_SERVE.json["arrivals_s"]`` so a run can be
+                    replayed exactly.
+
+The HEADLINE metric is SLO-attainment goodput: ``goodput_rps`` counts
+only requests that both succeeded AND finished within ``--slo-ms``
+end-to-end (0: any success counts), per ROADMAP item 3 — raw
+throughput that blows the latency budget is not service.  503 sheds
+(admission control) are counted separately from failures: a shed is the
+server BEHAVING WELL under overload.
+
+``--replicas N`` serves through a ``ReplicaPool`` (health-checked
+failover, shedding via ``--max-queue``) instead of a bare engine —
+the shape the serve_failover chaos scenario drives.
 
 ``--check-generate`` re-runs every prompt through one-shot
 ``FFModel.generate()`` and counts greedy matches — the continuous batch
@@ -33,6 +49,7 @@ import json
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import List, Optional
 
@@ -91,6 +108,34 @@ def _post(url: str, prompt, n: int, timeout: float):
         return json.loads(resp.read())
 
 
+def _arrival_offsets(args, n: int) -> List[float]:
+    """Open-loop arrival offsets (seconds from start): an explicit
+    trace file when given (one float per line, or a JSON list; cycled
+    if shorter than the request count), else a seeded Poisson clock."""
+    if args.arrival_trace:
+        with open(args.arrival_trace) as f:
+            raw = f.read().strip()
+        if raw.startswith("["):
+            offs = [float(x) for x in json.loads(raw)]
+        else:
+            offs = [float(l) for l in raw.splitlines() if l.strip()]
+        if not offs:
+            raise ValueError(f"{args.arrival_trace}: empty arrival trace")
+        if len(offs) < n:   # cycle, shifted by the trace's span
+            span = max(offs) + (offs[1] - offs[0] if len(offs) > 1 else 1.0)
+            offs = [offs[i % len(offs)] + span * (i // len(offs))
+                    for i in range(n)]
+        return sorted(offs[:n])
+    import random
+
+    rng = random.Random(args.seed)
+    offs, delay = [], 0.0
+    for _ in range(n):
+        delay += rng.expovariate(args.rate)
+        offs.append(delay)
+    return offs
+
+
 def _pcts(vals: List[float]) -> dict:
     from .trace_report import percentile
 
@@ -111,6 +156,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--mode", choices=("closed", "open"), default="closed")
     p.add_argument("--rate", type=float, default=8.0,
                    help="open-loop arrival rate, req/s")
+    p.add_argument("--arrival-trace", default=None,
+                   help="open mode: replay arrival offsets (seconds) "
+                        "from this file instead of the Poisson clock")
+    p.add_argument("--slo-ms", type=float, default=0.0,
+                   help="end-to-end SLO for goodput (0: any success "
+                        "is good)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve via a ReplicaPool of this many engines "
+                        "(1: bare engine, today's path)")
+    p.add_argument("--max-queue", type=int, default=0,
+                   help="pool admission bound (FF_SERVE_MAX_QUEUE; "
+                        "0: unbounded)")
+    p.add_argument("--hedge-ms", type=float, default=0.0,
+                   help="pool tail-latency hedging (FF_SERVE_HEDGE_MS)")
+    p.add_argument("--replica-timeout", type=float, default=10.0,
+                   help="pool heartbeat staleness bound "
+                        "(FF_SERVE_REPLICA_TIMEOUT)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--vocab", type=int, default=32)
     p.add_argument("--max-seq", type=int, default=64)
@@ -135,23 +197,56 @@ def main(argv: Optional[List[str]] = None) -> int:
                           args.prompt_lens, args.new_tokens)
 
     from ..serving.api import ServingAPI
-    from ..serving.engine import InferenceEngine
 
-    engine = InferenceEngine(model, max_batch=args.max_batch,
-                             max_seq=args.max_seq,
-                             max_new_tokens=max(int(args.new_tokens
-                                                    .split(":")[1]), 1))
+    max_new = max(int(args.new_tokens.split(":")[1]), 1)
+    if args.replicas > 1:
+        from ..serving.config import ServeConfig
+        from ..serving.pool import ReplicaPool
+
+        scfg = ServeConfig.from_env(
+            max_batch=args.max_batch, max_seq=args.max_seq,
+            max_new_tokens=max_new, replicas=args.replicas,
+            max_queue=args.max_queue, hedge_ms=args.hedge_ms,
+            replica_timeout_s=args.replica_timeout)
+        engine = ReplicaPool(model, config=scfg)
+    else:
+        from ..serving.engine import InferenceEngine
+
+        engine = InferenceEngine(model, max_batch=args.max_batch,
+                                 max_seq=args.max_seq,
+                                 max_new_tokens=max_new)
     results: List[Optional[dict]] = [None] * len(reqs)
+    e2e: List[Optional[float]] = [None] * len(reqs)
     errors: List[str] = []
+    n_shed = 0
+    shed_lock = threading.Lock()
+    arrivals: List[float] = []
     t_start = time.perf_counter()
     with engine, ServingAPI(engine, port=0) as api:
-        print(f"loadgen: serving on {api.url}, firing {len(reqs)} "
-              f"requests ({args.mode} loop)", flush=True)
+        print(f"loadgen: serving on {api.url} "
+              f"({args.replicas} replica{'s' if args.replicas > 1 else ''}),"
+              f" firing {len(reqs)} requests ({args.mode} loop)",
+              flush=True)
 
         def fire(i: int) -> None:
+            nonlocal n_shed
             prompt, n = reqs[i]
+            t0 = time.perf_counter()
             try:
                 results[i] = _post(api.url, prompt, n, args.timeout)
+                e2e[i] = time.perf_counter() - t0
+            except urllib.error.HTTPError as e:
+                detail = ""
+                try:
+                    detail = json.loads(e.read()).get("error", "")
+                except Exception:  # noqa: BLE001 — body is best-effort
+                    pass
+                if e.code == 503 and detail.startswith("overloaded"):
+                    # admission control working as designed, not a bug
+                    with shed_lock:
+                        n_shed += 1
+                else:
+                    errors.append(f"request {i}: HTTP {e.code}: {detail}")
             except Exception as e:  # noqa: BLE001 — collected + reported
                 errors.append(f"request {i}: {type(e).__name__}: {e}")
 
@@ -174,12 +269,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             for t in threads:
                 t.start()
         else:
-            import random
-
-            rng = random.Random(args.seed)
-            delay = 0.0
-            for i in range(len(reqs)):
-                delay += rng.expovariate(args.rate)
+            arrivals = _arrival_offsets(args, len(reqs))
+            for i, delay in enumerate(arrivals):
                 t = threading.Timer(delay, fire, args=(i,))
                 t.daemon = True
                 t.start()
@@ -196,7 +287,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         wall = time.perf_counter() - t_start
         stats = engine.stats()
 
+    eng_keys = ("admitted", "completed", "failed", "timeouts",
+                "prefill_compiles", "step_iterations", "max_active")
+    if args.replicas > 1:
+        # fold the live incarnations' engine counters (a restarted
+        # replica's previous incarnation is gone — close enough for a
+        # benchmark headline)
+        per_rep = [r["engine"] for r in stats["replicas"].values()
+                   if r["engine"]]
+        occ = sum(e.get("occupancy_sum", 0) for e in per_rep)
+        iters = sum(e.get("step_iterations", 0) for e in per_rep)
+        mean_occ = occ / iters if iters else 0.0
+        eng_stats = {k: sum(e.get(k, 0) for e in per_rep)
+                     for k in eng_keys}
+        eng_stats["max_active"] = max(
+            [e.get("max_active", 0) for e in per_rep] or [0])
+        pool_stats = {k: stats[k] for k in
+                      ("shed", "hedged", "failovers", "replica_downs",
+                       "replica_restarts", "ready_replicas")}
+    else:
+        mean_occ = stats["mean_occupancy"]
+        eng_stats = {k: stats[k] for k in eng_keys}
+        pool_stats = None
+
     ok = [r for r in results if r is not None]
+    good = [i for i, r in enumerate(results)
+            if r is not None and (args.slo_ms <= 0 or (
+                e2e[i] is not None and e2e[i] * 1000.0 <= args.slo_ms))]
     bench = {
         "bench": "serving_loadgen",
         "mode": args.mode, "seed": args.seed,
@@ -204,20 +321,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         "concurrency": args.concurrency if args.mode == "closed"
         else None,
         "rate_rps": args.rate if args.mode == "open" else None,
+        "arrivals_s": [round(a, 4) for a in arrivals] or None,
         "max_batch": args.max_batch, "max_seq": args.max_seq,
-        "n_ok": len(ok), "n_fail": len(reqs) - len(ok),
+        "replicas": args.replicas,
+        "n_ok": len(ok), "n_shed": n_shed,
+        "n_fail": len(reqs) - len(ok) - n_shed,
         "wall_s": round(wall, 3),
+        "slo_ms": args.slo_ms,
+        "slo_attainment": round(len(good) / len(reqs), 4) if reqs
+        else 0.0,
+        "goodput_rps": round(len(good) / wall, 3) if wall > 0 else 0.0,
         "ttft_s": _pcts([r["ttft_s"] for r in ok if "ttft_s" in r]),
         "tpot_s": _pcts([r["tpot_s"] for r in ok if "tpot_s" in r]),
+        "e2e_s": _pcts([t for t in e2e if t is not None]),
         "queue_wait_s": _pcts([r["queue_wait_s"] for r in ok
                                if "queue_wait_s" in r]),
         "achieved_tokens_s": round(
             sum(len(r["tokens"]) for r in ok) / wall, 2) if wall > 0
         else 0.0,
-        "mean_batch_occupancy": round(stats["mean_occupancy"], 3),
-        "engine": {k: stats[k] for k in
-                   ("admitted", "completed", "failed", "timeouts",
-                    "prefill_compiles", "step_iterations", "max_active")},
+        "mean_batch_occupancy": round(mean_occ, 3),
+        "engine": eng_stats,
+        "pool": pool_stats,
     }
 
     if args.check_generate:
@@ -239,12 +363,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         f.write("\n")
     for e in errors:
         print(f"loadgen: ERROR {e}", file=sys.stderr)
-    print(f"loadgen: {len(ok)}/{len(reqs)} ok in {wall:.2f}s · "
+    shed_note = f" · {n_shed} shed" if n_shed else ""
+    print(f"loadgen: {len(ok)}/{len(reqs)} ok{shed_note} in {wall:.2f}s · "
+          f"goodput {bench['goodput_rps']:.2f} req/s "
+          f"(SLO attainment {bench['slo_attainment']:.0%}) · "
           f"TTFT p95 {bench['ttft_s'].get('p95', 0) * 1e3:.0f}ms · "
           f"{bench['achieved_tokens_s']:.1f} tok/s · "
           f"occupancy {bench['mean_batch_occupancy']:.2f} -> {args.out}",
           flush=True)
-    failed = (len(ok) != len(reqs)
+    # sheds are the server protecting itself, not a loadgen failure;
+    # anything else unaccounted for is
+    failed = (len(ok) + n_shed != len(reqs)
               or (args.check_generate
                   and bench["greedy_matches"] != len(ok)))
     return 1 if failed else 0

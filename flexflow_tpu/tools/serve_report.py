@@ -6,6 +6,10 @@ events, the per-token-boundary ``serve_batch_occupancy`` gauge) and
 renders the standard serving lens: request outcomes, queue-wait / TTFT /
 TPOT percentiles, achieved tokens/s, and batch occupancy over time —
 the metric that says whether continuous batching actually batched.
+Replica-pool runs additionally get "## Replicas": per-replica occupancy
+and completions plus the pool lifecycle (``replica_down`` /
+``replica_restart`` / ``request_failover`` / ``request_hedged`` /
+``request_shed`` / ``pool_drain`` events).
 
 STDLIB-ONLY, like every report CLI here: a trace from a serving TPU
 must be foldable on any laptop.
@@ -47,14 +51,24 @@ def render_report(records: List[Dict[str, Any]],
     admits: List[float] = []       # serve_prefill span start times
     ends: List[float] = []         # serve_decode span end times
     counters: Dict[str, float] = {}
+    pool_events: List[Dict[str, Any]] = []   # replica pool lifecycle
+    occ_by_rep: Dict[str, List[float]] = {}  # replica -> gauge values
+    _POOL_EVENTS = ("replica_down", "replica_restart", "request_failover",
+                    "request_hedged", "request_shed", "pool_drain")
     for r in records:
         t, name = r.get("t"), r.get("name")
         if t == "meta":
             meta = r
         elif t == "event" and name == "serve_request_done":
             done_events.append(r)
+        elif t == "event" and name in _POOL_EVENTS:
+            pool_events.append(r)
         elif t == "gauge" and name == "serve_batch_occupancy":
-            occ.append((float(r.get("ts", 0.0)), float(r.get("v", 0.0))))
+            v = float(r.get("v", 0.0))
+            occ.append((float(r.get("ts", 0.0)), v))
+            rep = r.get("attrs", {}).get("replica")
+            if rep:
+                occ_by_rep.setdefault(rep, []).append(v)
         elif t == "span" and name == "serve_prefill":
             admits.append(float(r.get("ts", 0.0)))
         elif t == "span" and name == "serve_decode":
@@ -145,6 +159,54 @@ def render_report(records: List[Dict[str, Any]],
                 bar = "#" * max(1, round(m * 2))
                 lines.append(f"| {lo:.2f}-{hi:.2f}s | {m:.2f} | `{bar}` |")
             lines.append("")
+
+    # ---- replicas (pool runs only) ------------------------------------
+    if occ_by_rep or pool_events:
+        def _pool_count(name: str, rep: Optional[str] = None,
+                        key: str = "replica") -> int:
+            return sum(1 for e in pool_events
+                       if e.get("name") == name
+                       and (rep is None
+                            or e.get("attrs", {}).get(key) == rep))
+
+        done_by_rep: Dict[str, Dict[str, int]] = {}
+        for e in done_events:
+            a = e.get("attrs", {})
+            rep = a.get("replica")
+            if not rep:
+                continue
+            d = done_by_rep.setdefault(rep, {"done": 0, "other": 0})
+            d["done" if a.get("status") == "done" else "other"] += 1
+        reps = sorted(set(occ_by_rep) | set(done_by_rep)
+                      | {e.get("attrs", {}).get("replica")
+                         for e in pool_events
+                         if e.get("attrs", {}).get("replica")})
+        lines += ["## Replicas", "",
+                  "| replica | boundaries | mean occupancy | done | "
+                  "failed | downs | restarts | failovers off |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for rep in reps:
+            ov = occ_by_rep.get(rep, [])
+            d = done_by_rep.get(rep, {"done": 0, "other": 0})
+            mean_o = sum(ov) / len(ov) if ov else 0.0
+            lines.append(
+                f"| {rep} | {len(ov)} | {mean_o:.2f} | {d['done']} | "
+                f"{d['other']} | {_pool_count('replica_down', rep)} | "
+                f"{_pool_count('replica_restart', rep)} | "
+                f"{_pool_count('request_failover', rep, 'from_replica')} |")
+        lines.append("")
+        shed = _pool_count("request_shed")
+        hedged = _pool_count("request_hedged")
+        fo = _pool_count("request_failover")
+        lines.append(f"- shed {shed} · hedged {hedged} · failovers {fo}")
+        drains = [e for e in pool_events if e.get("name") == "pool_drain"]
+        for e in drains:
+            a = e.get("attrs", {})
+            lines.append(f"- pool drained at t={float(e.get('ts', 0)):.2f}s"
+                         f" ({a.get('reason', '?')}; "
+                         f"{a.get('inflight', 0)} in flight, "
+                         f"{a.get('queued', 0)} queued)")
+        lines.append("")
 
     # ---- failures -----------------------------------------------------
     bad = [e for e in done_events

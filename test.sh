@@ -164,6 +164,31 @@ python -m flexflow_tpu.tools.health_report "$SMOKE_DIR/reshard/run1/trace.jsonl"
   || { echo "reshard smoke: health report missing reconfiguration section"; exit 1; }
 echo "reshard smoke: OK"
 
+# Serve-failover smoke: chaos kills 1 of 3 pool replicas mid-load; all
+# requests (incl. the killed replica's in-flight ones) must complete
+# bitwise-equal to one-shot generate(), the monitor must restart the
+# replica, serve_report must show the per-replica lens, and the goodput
+# headline lands in BENCH_SERVE.json (docs/serving.md "Resilience").
+python -m flexflow_tpu.testing.chaos_smoke --workdir "$SMOKE_DIR/serve_failover" \
+    --scenario serve_failover \
+  || { echo "serve-failover smoke: FAILED"; exit 1; }
+python -m flexflow_tpu.tools.serve_report "$SMOKE_DIR/serve_failover/serve_trace.jsonl" \
+  | grep -q "## Replicas" \
+  || { echo "serve-failover smoke: serve_report missing replicas section"; exit 1; }
+python - "$SMOKE_DIR/serve_failover/BENCH_SERVE.json" <<'EOF' \
+  || { echo "serve-failover smoke: BENCH_SERVE.json acceptance failed"; exit 1; }
+import json, sys
+b = json.load(open(sys.argv[1]))
+assert b["n_ok"] == b["requests"] and b["n_fail"] == 0, b
+assert b["goodput_rps"] > 0, b
+assert b["pool"]["replica_downs"] >= 1 and b["pool"]["failovers"] >= 1, b
+EOF
+echo "serve-failover smoke: OK ($(python -c "
+import json
+b = json.load(open('$SMOKE_DIR/serve_failover/BENCH_SERVE.json'))
+print(f\"goodput {b['goodput_rps']} req/s, \"
+      f\"{b['pool']['failovers']} failovers\")"))"
+
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \
             examples/keras/seq_mnist_mlp.py \
