@@ -753,6 +753,15 @@ def main():
     # initial phase is set at module load, not via _enter_phase — emit
     # its heartbeat here (stdlib-only module: safe before jax init)
     _telemetry_heartbeat("preflight")
+    # Live /metrics exporter (no-op unless FF_METRICS_PORT; stdlib-only
+    # module, safe pre-jax).  A bad knob value is loud; a busy port only
+    # costs the exporter, never the bench.
+    try:
+        from flexflow_tpu.observability import metrics as _ff_metrics
+
+        _ff_metrics.maybe_start()
+    except OSError as e:
+        print(f"bench: metrics exporter unavailable: {e}", file=sys.stderr)
     extra = _state["extra"]
 
     # ---- rung 1: does any chip answer?  (see ladder in the docstring) ----

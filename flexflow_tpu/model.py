@@ -135,6 +135,9 @@ class FFModel:
         # Health monitor (observability/health.py): non-None only when
         # FF_HEALTH rides an enabled telemetry log.
         self._health = None
+        # In-training per-op attribution (observability/opprof.py):
+        # non-None only when FF_OPPROF rides an enabled telemetry log.
+        self._opprof = None
         # Fault injector (testing/chaos.py, FF_CHAOS) and non-finite
         # step guard (runtime/resilience.py, FF_SKIP_NONFINITE) — both
         # resolved once at compile(), None when their env knob is unset
@@ -807,6 +810,7 @@ class FFModel:
         if self._telemetry is None:
             self._stepstats = None
             self._health = None
+            self._opprof = None
             return self._compile_impl(optimizer, loss_type, metrics, machine)
         with self._telemetry.span("compile", num_ops=len(self.ops)) as at:
             self._compile_impl(optimizer, loss_type, metrics, machine)
@@ -820,6 +824,13 @@ class FFModel:
             self._telemetry.add_observer(self._health.observe)
         else:
             self._health = None
+        from .observability import metrics as _ff_metrics
+        from .observability import opprof as _ff_opprof
+
+        # Live metrics plane (FF_METRICS_PORT) + in-training per-op
+        # attribution (FF_OPPROF) — both None-handle gated like health.
+        _ff_metrics.maybe_start(self._telemetry)
+        self._opprof = _ff_opprof.maybe_profiler(self, self._telemetry)
         from .observability import agreement as _ff_agreement
 
         _ff_agreement.emit_compile_prediction(self, self._telemetry)

@@ -27,6 +27,18 @@ the hot path performs ZERO event-log calls — every site guards on a
 ``agreement`` — continuous simulator validation: predicted per-op /
                 per-step times diffed against measured walls as
                 ``sim_prediction`` / ``sim_divergence`` events.
+``metrics``   — the LIVE plane: ``FF_METRICS_PORT``-gated in-process
+                registry tapping the event log's observer hook into
+                counters / gauges / rolling-window percentiles, served
+                as Prometheus text at ``/metrics`` (and JSON at
+                ``/debug/vars``) by a stdlib HTTP exporter; also
+                mounted on the serving API server.
+``opprof``    — ``FF_OPPROF``-cadence measured per-op attribution:
+                jitted fwd/bwd fragments timed in-process under a
+                step budget, emitted as ``op_runtime`` events, folded
+                into the agreement table with measured provenance,
+                and appended to the calibration corpus
+                ``tools/calibrate.py`` refits from.
 ``chipwatch`` — the opportunistic chip-session layer: subprocess TPU
                 probes with capped backoff (a wedged tunnel kills the
                 child, never the parent), and first-healthy-window
@@ -42,11 +54,13 @@ the hot path performs ZERO event-log calls — every site guards on a
                 ``--diff``).
 """
 
-from . import chipwatch, events, health, searchtrace
+from . import chipwatch, events, health, metrics, opprof, searchtrace
 from .events import EventLog, active_log, for_config
 from .health import HealthMonitor, read_heartbeat, write_heartbeat
+from .metrics import MetricsRegistry
 from .searchtrace import SearchRecorder
 
-__all__ = ["EventLog", "HealthMonitor", "SearchRecorder", "active_log",
-           "chipwatch", "events", "for_config", "health", "read_heartbeat",
+__all__ = ["EventLog", "HealthMonitor", "MetricsRegistry",
+           "SearchRecorder", "active_log", "chipwatch", "events",
+           "for_config", "health", "metrics", "opprof", "read_heartbeat",
            "searchtrace", "write_heartbeat"]

@@ -100,12 +100,19 @@ def emit_step_divergence(model, log, measured_p50_s: float,
 
 
 def emit_op_divergence(log, op_name: str, which: str, predicted_ms: float,
-                       measured_ms: float, src: str = "analytic") -> None:
+                       measured_ms: float, src: str = "analytic",
+                       measured_src: str = "standalone") -> None:
     """Per-op agreement row (emitted by ``op_profile`` next to each
-    measured wall)."""
+    measured wall, and by ``opprof`` on its in-training cadence).
+
+    Both sides carry provenance: ``src`` names where the PREDICTION came
+    from ("measured" cache hit vs "analytic" roofline), ``measured_src``
+    names where the MEASUREMENT came from ("standalone" one-shot profile
+    vs "opprof" in-training cadence fragments)."""
     if measured_ms <= 0:
         return
     log.event("sim_divergence", scope="op", op=op_name, which=which,
               predicted_ms=round(predicted_ms, 4),
               measured_ms=round(measured_ms, 4),
-              ratio=round(predicted_ms / measured_ms, 4), src=src)
+              ratio=round(predicted_ms / measured_ms, 4), src=src,
+              measured_src=measured_src)

@@ -13,8 +13,11 @@ monotonic clock relative to the log's creation):
                    "ts": .., "dur": .., "attrs": {..}}
   {"t": "counter", "name": .., "v": float, "total": float, "ts": ..,
                    "attrs": {..}}
-  {"t": "gauge",   "name": .., "v": float, "ts": ..}
+  {"t": "gauge",   "name": .., "v": float, "ts": .., "attrs": {..}}
   {"t": "event",   "name": .., "ts": .., "attrs": {..}}
+
+(``attrs`` is present only when non-empty — gauges carry them too,
+e.g. ``replica=`` on ``serve_batch_occupancy``.)
 
 Spans nest per thread (a thread-local stack links ``parent``); counters
 carry their running ``total`` so a tail-truncated trace still reports

@@ -1,0 +1,269 @@
+"""Measured per-op runtime attribution on a training cadence.
+
+``runtime/profiling.op_profile`` measures every op once, standalone, on
+demand; the agreement loop (``agreement.py``) otherwise validates the
+simulator only at *step* granularity.  This module is the cadence
+version: every ``FF_OPPROF`` steps it times a slice of the model's ops
+as jitted forward / value_and_grad fragments (the ``tools/opbench.py``
+harness, reused in-process) under a wall-clock budget, and
+
+  * emits an ``op_runtime`` event per measured fragment — measured vs
+    the non-measuring cost model's prediction, with both sides'
+    provenance (``src``: measured-cache hit or analytic roofline;
+    ``measured_src``: "opprof"),
+  * emits the matching per-op ``sim_divergence`` rows so
+    ``health_report`` folds in-training measurements into the same
+    agreement table as standalone profiles,
+  * appends each measured cost to the ``measured_v5e.json``-style
+    corpus (``FF_OPPROF_CORPUS``; entries are tagged with the platform
+    they were measured on, so CPU fragments can never masquerade as
+    chip timings) — the corpus ``tools/calibrate.py --fit-only``
+    refits machine constants from.
+
+Knobs (all parsed loudly — a typo'd cadence must not silently disable
+attribution):
+
+  FF_OPPROF           cadence in steps (int >= 1); unset = disabled
+  FF_OPPROF_BUDGET_S  wall budget per pass, default 2.0 s; the pass
+                      round-robins across ops and stops mid-list when
+                      the budget is spent, resuming there next time
+  FF_OPPROF_CORPUS    measured-corpus path (default: the committed
+                      ``simulator/measured_v5e.json`` cache)
+
+Disabled, this module costs nothing: ``maybe_profiler`` returns None
+and the per-step hook is one ``is not None`` test (the established
+None-handle pattern).  Step 0 is never measured (it contains the jit
+trace + XLA compile of the training step itself).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+DEFAULT_BUDGET_S = 2.0
+
+
+def cadence_from_env() -> Optional[int]:
+    """``FF_OPPROF`` as a step cadence, None when unset/empty."""
+    raw = os.environ.get("FF_OPPROF", "")
+    if raw == "":
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"FF_OPPROF={raw!r} is not an integer step cadence") from None
+    if n < 1:
+        raise ValueError(f"FF_OPPROF={n} must be >= 1")
+    return n
+
+
+def budget_from_env() -> float:
+    raw = os.environ.get("FF_OPPROF_BUDGET_S", "")
+    if raw == "":
+        return DEFAULT_BUDGET_S
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"FF_OPPROF_BUDGET_S={raw!r} is not a number") from None
+    if v <= 0:
+        raise ValueError(f"FF_OPPROF_BUDGET_S={v} must be > 0")
+    return v
+
+
+def corpus_path_from_env() -> str:
+    path = os.environ.get("FF_OPPROF_CORPUS", "")
+    if path:
+        return path
+    from ..simulator.cost_model import MEASURED_CACHE
+
+    return MEASURED_CACHE
+
+
+def maybe_profiler(model, log) -> Optional["OpProfiler"]:
+    """Resolve the per-model profiler at ``compile()``: None unless
+    ``FF_OPPROF`` is set AND telemetry is on (the events are the whole
+    product — without a log there is nothing to attribute into)."""
+    cadence = cadence_from_env()
+    if cadence is None or log is None:
+        return None
+    return OpProfiler(model, log, cadence=cadence,
+                      budget_s=budget_from_env(),
+                      corpus_path=corpus_path_from_env())
+
+
+class OpProfiler:
+    """Round-robin per-op fragment timer driven by ``StepStats``.
+
+    Fragments are built and jitted once per op (the compile is paid
+    inside the first pass's budget); later passes re-time the cached
+    callables.  A fragment that fails to build is skipped permanently —
+    one broken op must not starve the rest of the list.
+    """
+
+    def __init__(self, model, log, cadence: int,
+                 budget_s: float = DEFAULT_BUDGET_S,
+                 corpus_path: Optional[str] = None,
+                 target_platform: Optional[str] = None,
+                 iters: int = 5):
+        self.model = model
+        self.log = log
+        self.cadence = int(cadence)
+        self.budget_s = float(budget_s)
+        self.iters = int(iters)
+        self._rr = 0                       # round-robin cursor into ops
+        self._frags: Dict[str, Any] = {}   # op.name -> (fwd, bwd, params, xs)
+        self._broken: set = set()
+        self._predicted: Optional[Dict[str, Dict[str, Any]]] = None
+        self._corpus_cm = None
+        self._corpus_path = corpus_path
+        self._target_platform = target_platform
+        self.passes = 0
+        self.measured_total = 0
+
+    # -- predictions / corpus (lazy: heavy imports stay off compile) ----
+    def _predictions(self) -> Dict[str, Dict[str, Any]]:
+        if self._predicted is None:
+            from . import agreement
+
+            try:
+                self._predicted = agreement.predict_op_times(self.model)
+            except Exception:
+                self._predicted = {}
+        return self._predicted
+
+    def _corpus(self):
+        """A NON-measuring CostModel used purely for its canonical
+        ``_key`` and atomic ``_persist`` — entries land in the same
+        schema calibrate reads, tagged with the platform the fragment
+        actually ran on."""
+        if self._corpus_cm is None:
+            import jax
+
+            from ..simulator.cost_model import CostModel
+            from ..simulator.machine import TPUMachineModel
+
+            nd = self.model.machine.num_devices if self.model.machine else 1
+            self._corpus_cm = CostModel(
+                TPUMachineModel.calibrated(num_devices=nd),
+                measure=False, cache_path=self._corpus_path or "",
+                compute_dtype=self.model.config.compute_dtype,
+                target_platform=(self._target_platform
+                                 or jax.default_backend()))
+        return self._corpus_cm
+
+    # -- fragment construction ------------------------------------------
+    def _fragment(self, op):
+        """(fwd_jit, vag_jit, params, xs) for the op's per-part
+        sub-shape — the same shape logic as the measuring cost model
+        (per-shard inputs AND weights), timed with the opbench loop."""
+        cached = self._frags.get(op.name)
+        if cached is not None:
+            return cached
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.base import FwdCtx
+
+        pc = op.pc
+        cdt = (jnp.bfloat16 if "16" in self.model.config.compute_dtype
+               else jnp.float32)
+        key = jax.random.key(0)
+        xs = []
+        for j, t in enumerate(op.inputs):
+            sub = tuple(hi - lo + 1 for lo, hi in op.input_ranges(j, pc, 0))
+            if "int" in t.dtype:
+                xs.append(jnp.zeros(sub, jnp.int32))
+            else:
+                key, k = jax.random.split(key)
+                xs.append(jax.random.normal(k, sub, cdt))
+        owner = op.share_from if getattr(op, "share_from", None) else op
+        params = {}
+        for wi, w in enumerate(owner.weights):
+            tile = op.weight_tile(pc, wi, 0)
+            shape = tuple(hi - lo + 1 for lo, hi in tile) if tile else w.dims
+            key, k = jax.random.split(key)
+            params[w.name] = 0.02 * jax.random.normal(k, shape, cdt)
+        stats = op.init_stats()
+        ctx = FwdCtx(training=False, rng=key,
+                     stats_in={op.name: stats} if stats else {})
+
+        def fwd(params, xs):
+            return op.forward(params, list(xs), ctx)[0]
+
+        def loss(params, xs):
+            return jnp.sum(fwd(params, xs).astype(jnp.float32))
+
+        frag = (jax.jit(fwd), jax.jit(jax.value_and_grad(loss)),
+                params, xs)
+        self._frags[op.name] = frag
+        return frag
+
+    # -- the cadence hook (called by StepStats.timed_update) ------------
+    def on_step(self, step_idx: int) -> None:
+        if step_idx == 0 or step_idx % self.cadence != 0:
+            return
+        try:
+            self._run_pass(step_idx)
+        except Exception as e:  # noqa: BLE001 — attribution is advisory
+            self.log.event("op_runtime_error", error=repr(e),
+                           step=int(step_idx))
+
+    def _run_pass(self, step_idx: int) -> None:
+        from ..tools.opbench import time_jitted
+
+        ops = [op for op in self.model.ops
+               if getattr(op, "pc", None) is not None
+               and not op.pc.host_placed]
+        if not ops:
+            return
+        predicted = self._predictions()
+        cm = self._corpus()
+        t_start = time.perf_counter()
+        measured = 0
+        for i in range(len(ops)):
+            if time.perf_counter() - t_start >= self.budget_s:
+                break
+            op = ops[(self._rr + i) % len(ops)]
+            if op.name in self._broken:
+                continue
+            try:
+                fwd, vag, params, xs = self._fragment(op)
+            except Exception:
+                self._broken.add(op.name)
+                continue
+            pred = predicted.get(op.name, {})
+            for which, fn in (("forward", fwd), ("backward", vag)):
+                try:
+                    t = time_jitted(fn, params, xs, iters=self.iters)
+                except Exception:
+                    self._broken.add(op.name)
+                    break
+                meas_ms = t * 1e3
+                pred_ms = float(pred.get(f"{which}_ms", 0.0))
+                src = pred.get(f"{which}_src", "analytic")
+                self.log.event(
+                    "op_runtime", op=op.name, which=which,
+                    measured_ms=round(meas_ms, 4),
+                    predicted_ms=round(pred_ms, 4),
+                    ratio=round(pred_ms / meas_ms, 4) if meas_ms > 0
+                    else 0.0,
+                    src=src, step=int(step_idx))
+                from . import agreement
+
+                agreement.emit_op_divergence(
+                    self.log, op.name, which, pred_ms, meas_ms,
+                    src=src, measured_src="opprof")
+                cm._persist(cm._key(op, op.pc, which), float(t))
+            else:
+                measured += 1
+        self._rr = (self._rr + max(1, measured)) % len(ops)
+        self.passes += 1
+        self.measured_total += measured
+        self.log.event("op_runtime_pass", step=int(step_idx),
+                       ops_measured=int(measured), ops_total=len(ops),
+                       elapsed_s=round(time.perf_counter() - t_start, 4))
+        self.log.flush()

@@ -162,3 +162,6 @@ class StepStats:
         health = getattr(self.model, "_health", None)
         if health is not None:
             health.on_step(step_idx, log.to_rel(t0), dur, first)
+        opprof = getattr(self.model, "_opprof", None)
+        if opprof is not None:
+            opprof.on_step(step_idx)

@@ -191,7 +191,13 @@ def elastic_train(model, dataloader, epochs: int,
     if on_strategy_mismatch not in ("error", "recompute"):
         raise ValueError(f"on_strategy_mismatch={on_strategy_mismatch!r}: "
                          "expected 'error' or 'recompute'")
+    from ..observability import metrics as _metrics
     from ..parallel.strategy import strategies_fingerprint
+
+    # Live /metrics exporter for long training runs (no-op unless
+    # FF_METRICS_PORT is set); attaches to the model's telemetry log
+    # when one was resolved at compile().
+    _metrics.maybe_start(getattr(model, "_telemetry", None))
 
     mgr = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
     wd = StepWatchdog(step_timeout) if step_timeout else None

@@ -25,6 +25,12 @@ Endpoints::
                    serve
   GET  /readyz  -> readiness: 200 iff new submits would be accepted —
                    the load-balancer signal; 503 while draining or down
+  GET  /metrics -> Prometheus text: the live registry's series (when
+                   FF_METRICS_PORT lights up the metrics plane) plus
+                   scrape-time backend state — per-replica
+                   health/incarnation, queue depth
+                   (observability/metrics.py)
+  GET  /debug/vars -> the same aggregates as expvar-style JSON
 
 Sampling knobs are rejected (400): the engine is greedy-only, which is
 what keeps its outputs bitwise-equal to ``FFModel.generate()``.
@@ -38,6 +44,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..observability import metrics as _metrics
 from .queue import ServeError, ServeOverload, ServeTimeout
 
 # request knobs forwarded verbatim to InferenceEngine.submit
@@ -66,6 +73,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self) -> None:  # noqa: N802 — http.server API
         path = self.path.split("?")[0]
         backend = self.api.engine
@@ -88,6 +102,18 @@ class _Handler(BaseHTTPRequestHandler):
                 ready = bool(getattr(backend, "_accepting", False))
             self._reply(200 if ready else 503,
                         {"ready": ready, "uptime_s": uptime})
+        elif path == "/metrics":
+            # the backend's live state arrives via the provider that
+            # start() registered — shared with the standalone exporter
+            self._reply_text(
+                200, _metrics.scrape_text().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/debug/vars":
+            reg = _metrics.global_registry()
+            body = reg.render_vars() if reg is not None \
+                else {"disabled": True}
+            body["backend"] = backend.stats()
+            self._reply(200, body)
         else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
 
@@ -163,6 +189,7 @@ class ServingAPI:
         self.t0 = time.perf_counter()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._provider = None  # metrics scrape-time backend renderer
 
     @property
     def port(self) -> int:
@@ -182,9 +209,19 @@ class ServingAPI:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="ff-serve-http", daemon=True)
         self._thread.start()
+        # Light up the live metrics plane (no-op unless FF_METRICS_PORT
+        # is set) and publish this backend's scrape-time state — per-
+        # replica health/incarnation, queue depth — to every /metrics
+        # endpoint, standalone exporter included.
+        _metrics.maybe_start()
+        self._provider = lambda: _metrics.render_backend(self.engine)
+        _metrics.register_provider(self._provider)
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_provider", None) is not None:
+            _metrics.unregister_provider(self._provider)
+            self._provider = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -108,6 +108,46 @@ def _observability():
     return ", ".join(bits)
 
 
+def _metrics():
+    # Effective live-metrics env as observability/metrics.py and
+    # opprof.py will see it — a typo'd port or cadence raises HERE
+    # (required-style error in the detail), not silently at launch —
+    # plus a bind probe of the configured exporter port.
+    import socket
+
+    from ..observability import events, metrics, opprof
+
+    port = metrics.metrics_port_from_env()    # ValueError on garbage
+    cadence = opprof.cadence_from_env()       # ValueError on garbage
+    bits = []
+    if port is None:
+        bits.append("FF_METRICS_PORT=off")
+    else:
+        bits.append(f"FF_METRICS_PORT={port}")
+        host = os.environ.get("FF_METRICS_HOST", "")
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, port))
+            bits.append(f"bind {host or '0.0.0.0'}:{s.getsockname()[1]} ok")
+        finally:
+            s.close()
+        if not events._env_enabled():
+            bits.append("WARN: FF_METRICS_PORT set but FF_TELEMETRY off "
+                        "— the registry would see no events (training "
+                        "series empty; serving state still scrapes)")
+    if cadence is None:
+        bits.append("FF_OPPROF=off")
+    else:
+        bits.append(f"FF_OPPROF={cadence} "
+                    f"(budget {opprof.budget_from_env()}s, "
+                    f"corpus {opprof.corpus_path_from_env()})")
+        if not events._env_enabled():
+            bits.append("WARN: FF_OPPROF set but FF_TELEMETRY off — "
+                        "op attribution emits nothing without a log")
+    return ", ".join(bits)
+
+
 def _resilience():
     # Effective chaos/recovery env as chaos.py/resilience.py will see
     # it.  An invalid FF_CHAOS spec fails HERE (required-style error in
@@ -308,6 +348,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     plan += [("native libs", _native_libs, False),
              ("optional deps", _optional_deps, False),
              ("observability", _observability, False),
+             ("metrics", _metrics, False),
              ("perf", lambda: _perf(probe=not args.skip_accelerator), False),
              ("resilience", _resilience, False),
              ("reconfiguration", _reconfiguration, False),

@@ -11,8 +11,12 @@ simulator".  Sections:
   * data pipeline: cumulative data_wait vs step time,
   * simulator agreement: step-level predicted-vs-measured and the
     per-op table from ``sim_divergence`` events (ratio per op/dir,
-    worst-case band) — rows slot into CALIBRATION.md's multi-point
+    worst-case band, both sides' provenance — prediction src and
+    measurement src) — rows slot into CALIBRATION.md's multi-point
     validation table,
+  * op runtime: the in-training measured attribution table from
+    ``FF_OPPROF``'s ``op_runtime`` events (measured vs analytic ms,
+    divergence ratio, cadence coverage),
   * reconfiguration: online re-parallelization searches and strategy
     hot-swaps (``reconfig_search`` / ``strategy_swap`` events from
     runtime/reconfigure.py) with per-swap outcome, simulated gain,
@@ -41,6 +45,9 @@ def _fmt_attrs(attrs: Dict[str, Any], skip=("kind",)) -> str:
 
 
 def _collect(records: List[Dict[str, Any]]):
+    # Gauge records are intentionally unused here (trace_report renders
+    # them, attrs included); spans and events keep their full record —
+    # nothing is stripped on the way in.
     spans: Dict[str, List[Dict[str, Any]]] = {}
     events: Dict[str, List[Dict[str, Any]]] = {}
     meta: Dict[str, Any] = {}
@@ -154,8 +161,8 @@ def render_report(records: List[Dict[str, Any]]) -> str:
         if op_rows:
             lines.append("")
             lines.append("| op | dir | predicted ms | measured ms | ratio "
-                         "| source |")
-            lines.append("|---|---|---|---|---|---|")
+                         "| pred src | meas src |")
+            lines.append("|---|---|---|---|---|---|---|")
             worst_key, worst_off = None, 0.0
             ratios = []
             for key in sorted(op_rows):
@@ -170,7 +177,8 @@ def render_report(records: List[Dict[str, Any]]) -> str:
                     f"| {key[0]} | {key[1]} | "
                     f"{float(a.get('predicted_ms', 0.0)):.3f} | "
                     f"{float(a.get('measured_ms', 0.0)):.3f} | "
-                    f"{r:.2f} | {a.get('src', '?')} |")
+                    f"{r:.2f} | {a.get('src', '?')} | "
+                    f"{a.get('measured_src', 'standalone')} |")
             if ratios:
                 lines.append("")
                 lines.append(f"- per-op ratio band: {min(ratios):.2f}x – "
@@ -178,6 +186,38 @@ def render_report(records: List[Dict[str, Any]]) -> str:
                 if worst_key is not None:
                     lines.append(f"- worst-case ratio: {worst_off:.2f}x off "
                                  f"({worst_key[0]} {worst_key[1]})")
+        lines.append("")
+
+    # ---- in-training measured per-op attribution (FF_OPPROF) ----------
+    op_rt = events.get("op_runtime", [])
+    if op_rt:
+        latest: Dict[tuple, Dict[str, Any]] = {}
+        for e in op_rt:  # last measurement per (op, which) wins
+            a = e.get("attrs", {})
+            latest[(a.get("op", "?"), a.get("which", "?"))] = a
+        lines.append("## Op runtime (in-training attribution)")
+        lines.append("")
+        passes = events.get("op_runtime_pass", [])
+        if passes:
+            pa = [p.get("attrs", {}) for p in passes]
+            covered = sum(int(a.get("ops_measured", 0)) for a in pa)
+            total = max(int(a.get("ops_total", 0)) for a in pa)
+            spent = sum(float(a.get("elapsed_s", 0.0)) for a in pa)
+            lines.append(
+                f"- cadence coverage: {len(pa)} passes, {covered} op "
+                f"measurements over {total} eligible ops, "
+                f"{spent:.2f}s spent")
+            lines.append("")
+        lines.append("| op | which | measured ms | predicted ms | ratio "
+                     "| prediction src |")
+        lines.append("|---|---|---|---|---|---|")
+        for (op, which), a in sorted(latest.items()):
+            lines.append(
+                f"| {op} | {which} | "
+                f"{float(a.get('measured_ms', 0.0)):.3f} | "
+                f"{float(a.get('predicted_ms', 0.0)):.3f} | "
+                f"{float(a.get('ratio', 0.0)):.3f} | "
+                f"{a.get('src', '?')} |")
         lines.append("")
 
     # ---- recovery (resilience.py narration) ---------------------------

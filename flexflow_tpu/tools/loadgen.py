@@ -188,6 +188,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="verify each output against one-shot generate()")
     args = p.parse_args(argv)
 
+    # Live /metrics exporter (no-op unless FF_METRICS_PORT): started
+    # BEFORE the model builds so the registry taps the telemetry log
+    # from the first training step through the serving run.
+    from ..observability import events, metrics
+
+    metrics.maybe_start(events.active_log())
+
     print(f"loadgen: building model (vocab={args.vocab}, "
           f"max_seq={args.max_seq}, train_iters={args.train_iters})",
           flush=True)

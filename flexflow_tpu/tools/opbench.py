@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -69,6 +68,31 @@ def _build(op_type: str, batch: int, in_shape: Tuple[int, ...], args):
     return model, inputs, op
 
 
+def time_jitted(fn, params, xs, iters: int = 10) -> float:
+    """Mean seconds/call for a jitted ``fn(params, xs)``.
+
+    The harness ``bench_op`` and the in-training attribution cadence
+    (``observability/opprof.py``) share: one sync'd warmup call pays
+    compile, then ``iters-1`` unsync'd dispatches with a final sync'd
+    call — host dispatch pipelines, the tail sync bounds the batch."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    def sync(out):
+        head = out[0] if isinstance(out, tuple) else out
+        jax.device_get(jnp.sum(head.astype(jnp.float32)))
+
+    sync(fn(params, xs))  # compile+warmup
+    # the sync'd call is the iters-th timed call
+    t0 = _t.perf_counter()
+    for _ in range(iters - 1):
+        fn(params, xs)
+    sync(fn(params, xs))
+    return (_t.perf_counter() - t0) / iters
+
+
 def bench_op(op_type: str, batch: int, in_shape: Tuple[int, ...], args,
              iters: int = 10) -> dict:
     import jax
@@ -95,17 +119,7 @@ def bench_op(op_type: str, batch: int, in_shape: Tuple[int, ...], args,
     flops = op.flops_per_sample() * batch
     for which, fn in (("fwd", jax.jit(fwd)),
                       ("fwd+bwd", jax.jit(jax.value_and_grad(loss)))):
-        def sync(out):
-            head = out[0] if isinstance(out, tuple) else out
-            jax.device_get(jnp.sum(head.astype(jnp.float32)))
-
-        sync(fn(params, xs))  # compile+warmup
-        # the sync'd call is the iters-th timed call
-        t0 = time.perf_counter()
-        for _ in range(iters - 1):
-            fn(params, xs)
-        sync(fn(params, xs))
-        dt = (time.perf_counter() - t0) / iters
+        dt = time_jitted(fn, params, xs, iters=iters)
         eff_flops = flops * (3.0 if which == "fwd+bwd" else 1.0)
         results[which] = (dt, eff_flops / dt / 1e9 if dt > 0 else 0.0)
     return results

@@ -75,8 +75,11 @@ def render_report(records: List[Dict[str, Any]], top_k: int = 8) -> str:
             # so truncated traces still aggregate correctly
             counters[r.get("name", "?")] = r.get("total", r.get("v", 0.0))
         elif t == "gauge":
+            # keep (value, attrs) pairs — gauges carry attrs too
+            # (e.g. replica= on serve_batch_occupancy); dropping them
+            # here would lose the per-replica dimension for renderers
             gauges.setdefault(r.get("name", "?"), []).append(
-                float(r.get("v", 0.0)))
+                (float(r.get("v", 0.0)), r.get("attrs") or {}))
         elif t == "event":
             events.setdefault(r.get("name", "?"), []).append(r)
         elif t == "meta":
@@ -162,7 +165,7 @@ def render_report(records: List[Dict[str, Any]], top_k: int = 8) -> str:
         vals = gauges.get(key)
         if not vals:
             continue
-        v = vals[-1]
+        v = vals[-1][0]
         grows.append((label, fmt.format(v) if fmt else _fmt_bytes(v)))
     if grows:
         lines.append("## Gauges (last value)")
@@ -189,6 +192,38 @@ def render_report(records: List[Dict[str, Any]], top_k: int = 8) -> str:
         lines.append("|---|---|---|---|")
         for op, fwd, bwd, tot in rows[:top_k]:
             lines.append(f"| {op} | {fwd:.3f} | {bwd:.3f} | {tot:.3f} |")
+        lines.append("")
+
+    # ---- in-training measured per-op attribution (FF_OPPROF) ----------
+    op_rt = events.get("op_runtime", [])
+    if op_rt:
+        latest: Dict[tuple, Dict[str, Any]] = {}
+        for e in op_rt:  # last measurement per (op, which) wins
+            a = e.get("attrs", {})
+            latest[(a.get("op", "?"), a.get("which", "?"))] = a
+        lines.append("## Op runtime (in-training attribution)")
+        lines.append("")
+        passes = events.get("op_runtime_pass", [])
+        if passes:
+            pa = [p.get("attrs", {}) for p in passes]
+            covered = sum(int(a.get("ops_measured", 0)) for a in pa)
+            total = max(int(a.get("ops_total", 0)) for a in pa)
+            spent = sum(float(a.get("elapsed_s", 0.0)) for a in pa)
+            lines.append(
+                f"- cadence coverage: {len(pa)} passes, "
+                f"{covered} op measurements over {total} eligible ops, "
+                f"{spent:.2f}s spent")
+            lines.append("")
+        lines.append("| op | which | measured ms | predicted ms | "
+                     "ratio | prediction src |")
+        lines.append("|---|---|---|---|---|---|")
+        for (op, which), a in sorted(latest.items()):
+            lines.append(
+                f"| {op} | {which} | "
+                f"{float(a.get('measured_ms', 0.0)):.3f} | "
+                f"{float(a.get('predicted_ms', 0.0)):.3f} | "
+                f"{float(a.get('ratio', 0.0)):.3f} | "
+                f"{a.get('src', '?')} |")
         lines.append("")
 
     # ---- resilience (chaos + recovery narration) ----------------------

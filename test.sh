@@ -141,6 +141,49 @@ import json, sys
 b = json.load(open('$SMOKE_DIR/BENCH_SERVE.json'))
 print(f\"{b['achieved_tokens_s']} tok/s, occupancy {b['mean_batch_occupancy']}\")"))"
 
+# Metrics smoke: live /metrics while loadgen drives a 2-replica pool —
+# one mid-load scrape must return serving gauges (per-replica health)
+# AND training counters in valid Prometheus text
+# (docs/observability.md "Live metrics endpoint").
+METRICS_PORT=9109
+METRICS_TRACE="$SMOKE_DIR/metrics_serve.jsonl"
+FF_TELEMETRY=1 FF_TELEMETRY_FILE="$METRICS_TRACE" \
+  FF_METRICS_PORT=$METRICS_PORT FF_METRICS_HOST=127.0.0.1 \
+  python -m flexflow_tpu.tools.loadgen --requests 24 --concurrency 4 \
+    --replicas 2 --seed 0 --train-iters 20 \
+    --out "$SMOKE_DIR/BENCH_METRICS.json" > /dev/null &
+LOADGEN_PID=$!
+python - "$METRICS_PORT" <<'EOF' \
+  || { kill $LOADGEN_PID 2>/dev/null; echo "metrics smoke: scrape failed"; exit 1; }
+import re, sys, time, urllib.request
+url = f"http://127.0.0.1:{sys.argv[1]}/metrics"
+want = ("ff_replica_up", "ff_samples_total")   # serving + training series
+sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eE]+$')
+deadline = time.time() + 180
+while time.time() < deadline:
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert r.headers["Content-Type"].startswith("text/plain"), \
+                r.headers["Content-Type"]
+            text = r.read().decode()
+    except OSError:
+        time.sleep(0.5)
+        continue
+    if all(w in text for w in want):
+        n = 0
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert sample.match(line), f"malformed sample: {line!r}"
+                n += 1
+        print(f"metrics smoke: scraped {n} well-formed samples mid-load")
+        sys.exit(0)
+    time.sleep(0.5)
+sys.exit(f"never saw {want} at {url}")
+EOF
+wait $LOADGEN_PID \
+  || { echo "metrics smoke: loadgen exited non-zero"; exit 1; }
+echo "metrics smoke: OK"
+
 # Chaos smoke: one seeded FF_CHAOS run injects a NaN step, a mid-epoch
 # SIGTERM, and a failing checkpoint write; the resumed run must finish
 # bitwise-equal to an uninterrupted baseline and the trace must narrate

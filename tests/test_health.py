@@ -295,6 +295,24 @@ def synthetic_records():
                      "attrs": {"scope": "op", "op": op, "which": which,
                                "predicted_ms": p, "measured_ms": m,
                                "ratio": round(p / m, 4), "src": src}})
+    # FF_OPPROF in-training attribution: a cadence pass over two ops,
+    # with the matching measured-provenance agreement row for one
+    recs.append({"t": "event", "name": "sim_divergence", "ts": 3.5,
+                 "attrs": {"scope": "op", "op": "dense2",
+                           "which": "forward", "predicted_ms": 0.6,
+                           "measured_ms": 0.5, "ratio": 1.2,
+                           "src": "analytic", "measured_src": "opprof"}})
+    for op, which, m, p in [("dense2", "forward", 0.5, 0.6),
+                            ("dense2", "backward", 1.4, 1.2),
+                            ("sm", "forward", 0.05, 0.04)]:
+        recs.append({"t": "event", "name": "op_runtime", "ts": 3.5,
+                     "attrs": {"op": op, "which": which,
+                               "measured_ms": m, "predicted_ms": p,
+                               "ratio": round(p / m, 4),
+                               "src": "analytic", "step": 4}})
+    recs.append({"t": "event", "name": "op_runtime_pass", "ts": 3.6,
+                 "attrs": {"step": 4, "ops_measured": 2, "ops_total": 6,
+                           "elapsed_s": 0.42}})
     recs.append({"t": "event", "name": "bench_phase", "ts": 0.0,
                  "attrs": {"phase": "preflight"}})
     recs.append({"t": "event", "name": "bench_phase", "ts": 1.9,
@@ -316,8 +334,13 @@ def test_report_sections(tmp_path):
     for section in ["## Health findings", "## Step health",
                     "## Data pipeline",
                     "## Simulator agreement (predicted vs measured)",
+                    "## Op runtime (in-training attribution)",
                     "## Last phase"]:
         assert section in report, f"missing {section}"
+    # agreement rows carry both sides' provenance
+    assert "| measured | standalone |" in report
+    assert "| analytic | opprof |" in report
+    assert "cadence coverage: 1 passes, 2 op measurements" in report
     assert "nonfinite_loss" in report
     assert "straggler" in report and "data_wait" in report
     # the straggler (4.76x) beats the op-table worst (dense1 4.00x)

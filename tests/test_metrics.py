@@ -1,0 +1,296 @@
+"""Metrics-plane tests: Prometheus text well-formedness under a live
+scrape, percentile agreement with the trace_report reference math,
+counter totals under concurrent writer threads, and the zero-observer
+guarantee when FF_METRICS_PORT is unset.
+
+Pure stdlib — no jax import, so this file also proves metrics.py stays
+safe on the pre-jax import path (bench.py starts the exporter before
+the backend initializes).
+"""
+
+import json
+import re
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.observability import events, metrics
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    """Fresh env + process-wide singletons per test."""
+    for var in ("FF_TELEMETRY", "FF_TELEMETRY_FILE", "FF_METRICS_PORT",
+                "FF_METRICS_HOST", "FF_METRICS_WINDOW"):
+        monkeypatch.delenv(var, raising=False)
+    events.reset_active()
+    metrics.stop()
+    yield
+    metrics.stop()
+    events.reset_active()
+
+
+# one sample line: name{labels} value  (labels optional; value is a
+# float literal — the renderer uses %g so no NaN/Inf/timestamps here)
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r' [-+]?[0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?$')
+
+
+def assert_prom_wellformed(text):
+    """Every non-comment line parses as a sample, and every sample's
+    base family has a preceding # TYPE declaration."""
+    assert text.endswith("\n")
+    typed = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        if line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+        name = line.split("{")[0].split(" ")[0]
+        family_ok = (name in typed
+                     or name.rsplit("_", 1)[0] in typed)  # _sum/_count
+        assert family_ok, f"sample {name} has no # TYPE declaration"
+
+
+# ---------------------------------------------------------------------------
+# env knob parsing
+# ---------------------------------------------------------------------------
+
+def test_port_unset_is_none():
+    assert metrics.metrics_port_from_env() is None
+
+
+def test_port_garbage_is_loud(monkeypatch):
+    monkeypatch.setenv("FF_METRICS_PORT", "banana")
+    with pytest.raises(ValueError, match="FF_METRICS_PORT"):
+        metrics.metrics_port_from_env()
+    monkeypatch.setenv("FF_METRICS_PORT", "70000")
+    with pytest.raises(ValueError, match="outside"):
+        metrics.metrics_port_from_env()
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when disabled
+# ---------------------------------------------------------------------------
+
+def test_disabled_registers_no_observer(tmp_path):
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    assert metrics.maybe_start(log) is None
+    assert log._observers == []
+    assert metrics.global_registry() is None
+    assert metrics.server_port() is None
+    # scrape helper still renders (serving mounts it unconditionally)
+    assert "registry disabled" in metrics.scrape_text()
+
+
+# ---------------------------------------------------------------------------
+# registry folding + rendering
+# ---------------------------------------------------------------------------
+
+def _feed(reg, recs):
+    for r in recs:
+        reg.observe(r)
+
+
+def test_render_prom_wellformed_and_values():
+    reg = metrics.MetricsRegistry(window=64)
+    _feed(reg, [
+        {"t": "counter", "name": "samples", "v": 32.0},
+        {"t": "counter", "name": "samples", "v": 32.0},
+        {"t": "counter", "name": "serve_failed", "v": 1.0,
+         "attrs": {"status": "shed", "request": "r-123"}},
+        {"t": "gauge", "name": "mfu", "v": 0.41},
+        {"t": "gauge", "name": "serve_batch_occupancy", "v": 0.5,
+         "attrs": {"replica": "r0"}},
+        {"t": "span", "name": "step", "dur": 0.01},
+        {"t": "span", "name": "step", "dur": 0.03},
+        {"t": "event", "name": "replica_failover",
+         "attrs": {"reason": "health"}},
+        {"t": "event", "name": "serve_request_done",
+         "attrs": {"ttft_s": 0.12, "tpot_s": 0.004}},
+    ])
+    text = reg.render_prom()
+    assert_prom_wellformed(text)
+    assert "ff_samples_total 64" in text
+    # allowlisted label kept, request id dropped (cardinality bound)
+    assert 'ff_serve_failed_total{status="shed"} 1' in text
+    assert 'request="r-123"' not in text
+    assert "ff_mfu 0.41" in text
+    assert 'ff_serve_batch_occupancy{replica="r0"} 0.5' in text
+    # span -> summary with unit suffix
+    assert "ff_step_seconds_count 2" in text
+    assert "ff_step_seconds_sum 0.04" in text
+    # events fold into one family, labelled by event name
+    assert 'ff_events_total{event="replica_failover"} 1' in text
+    # request-done latencies extracted into histograms
+    assert "ff_serve_ttft_seconds_count 1" in text
+    assert "ff_serve_tpot_seconds_count 1" in text
+    assert "ff_metrics_records_seen_total 9" in text
+
+
+def test_histogram_percentiles_match_reference():
+    from flexflow_tpu.tools.trace_report import percentile as ref_pct
+    reg = metrics.MetricsRegistry(window=256)
+    durs = [0.001 * (i % 17 + 1) for i in range(100)]
+    _feed(reg, [{"t": "span", "name": "step", "dur": d} for d in durs])
+    snap = reg.render_vars()["histograms"]["step"]
+    vals = sorted(durs)
+    for q in (50.0, 95.0, 99.0):
+        assert snap[f"p{q:g}"] == pytest.approx(ref_pct(vals, q), abs=1e-9)
+        # and the module-local copy agrees with the trace_report math
+        assert metrics.percentile(vals, q) == pytest.approx(
+            ref_pct(vals, q), abs=1e-12)
+    assert snap["count"] == 100
+    assert snap["sum"] == pytest.approx(sum(durs), abs=1e-6)
+
+
+def test_window_bounds_quantiles_but_not_totals():
+    reg = metrics.MetricsRegistry(window=8)
+    _feed(reg, [{"t": "span", "name": "s", "dur": float(i)}
+                for i in range(100)])
+    snap = reg.render_vars()["histograms"]["s"]
+    assert snap["count"] == 100               # monotonic
+    assert snap["sum"] == pytest.approx(sum(range(100)))
+    assert snap["p50"] >= 92.0                # quantiles from last 8 only
+
+
+def test_attach_seeds_preexisting_totals(tmp_path):
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    log.counter("samples", 128.0)
+    reg = metrics.MetricsRegistry()
+    reg.attach(log)
+    log.counter("samples", 32.0)
+    log.close()
+    assert "ff_samples_total 160" in reg.render_prom()
+
+
+# ---------------------------------------------------------------------------
+# concurrency: writer races + scrape-under-load
+# ---------------------------------------------------------------------------
+
+def test_counter_totals_survive_writer_races(tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_METRICS_PORT", "0")
+    monkeypatch.setenv("FF_METRICS_HOST", "127.0.0.1")
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    reg = metrics.maybe_start(log)
+    assert reg is not None and len(log._observers) == 1
+    # second call must not double-attach (idempotence)
+    assert metrics.maybe_start(log) is reg
+    assert len(log._observers) == 1
+
+    port = metrics.server_port()
+    n_threads, n_incr = 8, 200
+    stop_scraping = threading.Event()
+    scrapes = []
+
+    def scrape_loop():
+        while not stop_scraping.is_set():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                scrapes.append(r.read().decode())
+
+    def writer():
+        for _ in range(n_incr):
+            log.counter("races", 1.0)
+            log.span_at("step", 0.0, 0.001)
+
+    scraper = threading.Thread(target=scrape_loop)
+    scraper.start()
+    writers = [threading.Thread(target=writer) for _ in range(n_threads)]
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop_scraping.set()
+    scraper.join()
+    log.close()
+
+    # every mid-load scrape was well-formed
+    assert scrapes
+    for text in scrapes:
+        assert_prom_wellformed(text)
+    # no lost increments despite 8 racing observer threads
+    final = reg.render_vars()
+    assert final["counters"]["races"] == n_threads * n_incr
+    assert final["histograms"]["step"]["count"] == n_threads * n_incr
+    assert log.totals["races"] == n_threads * n_incr
+
+
+def test_debug_vars_endpoint(tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_METRICS_PORT", "0")
+    monkeypatch.setenv("FF_METRICS_HOST", "127.0.0.1")
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    metrics.maybe_start(log)
+    log.counter("samples", 16.0)
+    log.close()
+    port = metrics.server_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/vars", timeout=5) as r:
+        body = json.loads(r.read())
+    assert body["counters"]["samples"] == 16.0
+    assert body["records_seen"] >= 1
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=5)
+    assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# serving backend provider (pool-shaped fake; no jax needed)
+# ---------------------------------------------------------------------------
+
+class _FakePool:
+    def healthz(self):
+        return {"status": "ok", "queued": 3, "inflight": 2,
+                "replicas": [
+                    {"name": "r0", "state": "ready",
+                     "incarnation": "r0#1", "restarts": 0},
+                    {"name": "r1", "state": "restarting",
+                     "incarnation": "r1#4", "restarts": 3},
+                ]}
+
+
+def test_backend_provider_renders_replica_state():
+    pool = _FakePool()
+    provider = lambda: metrics.render_backend(pool)  # noqa: E731
+    metrics.register_provider(provider)
+    try:
+        text = metrics.scrape_text()
+        assert_prom_wellformed(text)
+        assert "ff_serve_queue_depth 3" in text
+        assert "ff_serve_inflight 2" in text
+        assert 'ff_replica_up{replica="r0",state="ready"} 1' in text
+        assert 'ff_replica_up{replica="r1",state="restarting"} 0' in text
+        # incarnation uid is a string -> info-style series (value 1)
+        assert ('ff_replica_incarnation{incarnation="r1#4",replica="r1"} 1'
+                in text)
+        assert 'ff_replica_restarts{replica="r1"} 3' in text
+    finally:
+        metrics.unregister_provider(provider)
+    assert "ff_replica_up" not in metrics.scrape_text()
+
+
+def test_broken_backend_never_breaks_scrape():
+    class Broken:
+        def healthz(self):
+            raise RuntimeError("pool wedged")
+
+    text = metrics.render_backend(Broken())
+    assert "backend render failed" in text
+    assert_prom_wellformed(text)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
