@@ -2186,7 +2186,7 @@ class FFModel:
     # static shapes, no per-token retrace)
     # ------------------------------------------------------------------
     def _run_graph_decode(self, params, caches, batch, pos, ctx,
-                          pre_env=None, skip=()):
+                          pre_env=None, skip=(), block_tables=None):
         env: Dict[int, jax.Array] = dict(pre_env) if pre_env else {}
         cdtype = self.compute_dtype
         for t in self.input_tensors:
@@ -2210,8 +2210,15 @@ class FFModel:
             if op.name in skip:
                 continue
             xs = [env[t.guid] for t in op.inputs]
-            ys, c = op.decode(params.get(op.param_key, {}), xs,
-                              caches.get(op.name), pos, ctx)
+            if block_tables is not None and hasattr(op, "decode_paged"):
+                # paged serving path: the op's cache rows are pool
+                # blocks, addressed through the per-slot block tables
+                ys, c = op.decode_paged(params.get(op.param_key, {}), xs,
+                                        caches.get(op.name), pos,
+                                        block_tables, ctx)
+            else:
+                ys, c = op.decode(params.get(op.param_key, {}), xs,
+                                  caches.get(op.name), pos, ctx)
             new_caches[op.name] = c
             for t, y in zip(op.outputs, ys):
                 env[t.guid] = y
@@ -2291,8 +2298,39 @@ class FFModel:
                                        self.compute_dtype)
                 for op in self.ops if op.name not in skip}
 
+    def pageable_decode(self, skip=()) -> bool:
+        """True when every cache-carrying op has a paged decode path —
+        the serving engine's gate for block-paged KV (decoder-only
+        transformers qualify; LSTM/seq2seq stacks fall back dense)."""
+        from .ops.base import Op
+        return all(type(op).init_cache is Op.init_cache
+                   or hasattr(op, "init_paged_cache")
+                   for op in self.ops if op.name not in skip)
+
+    def init_paged_decode_caches(self, num_blocks: int, block_size: int,
+                                 skip=()):
+        """Fresh block-pool cache pytree: cache-carrying ops get
+        ``(num_blocks, H, block_size, D)`` pools (block 0 is the garbage
+        sink, serving/kvpool.py); stateless ops get None."""
+        from .ops.base import Op
+        out = {}
+        for op in self.ops:
+            if op.name in skip:
+                continue
+            if type(op).init_cache is Op.init_cache:
+                out[op.name] = None
+            elif hasattr(op, "init_paged_cache"):
+                out[op.name] = op.init_paged_cache(num_blocks, block_size,
+                                                   self.compute_dtype)
+            else:
+                raise ValueError(
+                    f"paged decode: op {op.name!r} "
+                    f"({type(op).__name__}) carries a decode cache but "
+                    f"has no paged path — serve it with FF_SERVE_PAGED=off")
+        return out
+
     def decode_step(self, params, stats, caches, cur, pos, tok_t, pos_t,
-                    pre_env=None, skip=()):
+                    pre_env=None, skip=(), block_tables=None):
         """One single-token decode step: feed token ids ``cur`` (B,)
         int32 at position ``pos`` and return (probs (B, V) float32, new
         caches).  ``pos`` is a scalar, or a per-row (B,) vector when the
@@ -2310,7 +2348,8 @@ class FFModel:
                      stats_in=stats)
         env, caches = self._run_graph_decode(params, caches, batch, pos,
                                              ctx, pre_env=pre_env,
-                                             skip=skip)
+                                             skip=skip,
+                                             block_tables=block_tables)
         probs = env[self.final_tensor().guid][:, -1, :].astype(jnp.float32)
         return probs, caches
 

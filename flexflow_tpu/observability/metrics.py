@@ -54,7 +54,8 @@ from . import events
 # attr keys that may become Prometheus labels; everything else is
 # dropped from the label set (NOT from the trace) to bound cardinality
 LABEL_KEYS = ("event", "kind", "op", "outcome", "phase", "reason",
-              "replica", "scope", "site", "src", "status", "which")
+              "replica", "scope", "site", "src", "status", "which",
+              "window")
 
 # histogram quantiles exposed on every summary series
 QUANTILES = (50.0, 95.0, 99.0)
@@ -212,6 +213,14 @@ class MetricsRegistry:
             h = self._hists[name] = _Hist(self._window)
         return h
 
+    def has_series(self, name: str) -> bool:
+        """True when this registry already carries the series — backend
+        providers use it to avoid emitting a duplicate metric name in
+        the same scrape body."""
+        with self._lock:
+            return any(k[0] == name for k in self._counters) \
+                or any(k[0] == name for k in self._gauges)
+
     # -- rendering ------------------------------------------------------
     def _snapshot(self):
         with self._lock:
@@ -290,6 +299,26 @@ def unregister_provider(fn: Callable[[], str]) -> None:
             _providers.remove(fn)
 
 
+def _kv_lines(used: int, free: int, hits: int) -> List[str]:
+    """Paged-KV scrape lines from backend *state*.  The engine also
+    streams ``serve_kv_blocks_used``/``serve_prefix_hits`` through its
+    telemetry log; when an attached registry already renders those
+    series the state-side copy is suppressed so one scrape body never
+    carries a duplicate metric name (``blocks_free`` is state-only —
+    always emitted)."""
+    reg = global_registry()
+    out: List[str] = []
+    if reg is None or not reg.has_series("serve_kv_blocks_used"):
+        out.append("# TYPE ff_serve_kv_blocks_used gauge")
+        out.append(f"ff_serve_kv_blocks_used {used}")
+    out.append("# TYPE ff_serve_kv_blocks_free gauge")
+    out.append(f"ff_serve_kv_blocks_free {free}")
+    if reg is None or not reg.has_series("serve_prefix_hits"):
+        out.append("# TYPE ff_serve_prefix_hits_total counter")
+        out.append(f"ff_serve_prefix_hits_total {hits}")
+    return out
+
+
 def render_backend(backend) -> str:
     """Prometheus lines for a serving backend's live state: per-replica
     health/incarnation (pool) or engine queue/active depth — values that
@@ -328,12 +357,25 @@ def render_backend(backend) -> str:
             if rsts:
                 out.append("# TYPE ff_replica_restarts gauge")
                 out.extend(rsts)
+            # fold paged-KV occupancy across live replica engines
+            kvs = [r["engine"]["kv"]
+                   for r in backend.stats().get("replicas", {}).values()
+                   if r.get("engine") and r["engine"].get("kv")]
+            if kvs:
+                out.extend(_kv_lines(
+                    sum(k["blocks_used"] for k in kvs),
+                    sum(k["blocks_free"] for k in kvs),
+                    sum(k["prefix_hits"] for k in kvs)))
         elif hasattr(backend, "stats"):            # bare InferenceEngine
             st = backend.stats()
             out.append("# TYPE ff_serve_queue_depth gauge")
             out.append(f"ff_serve_queue_depth {st.get('queued', 0)}")
             out.append("# TYPE ff_serve_active gauge")
             out.append(f"ff_serve_active {st.get('active', 0)}")
+            kv = st.get("kv")
+            if kv:
+                out.extend(_kv_lines(kv["blocks_used"], kv["blocks_free"],
+                                     kv["prefix_hits"]))
     except Exception as e:  # noqa: BLE001 — scrape must not 500
         out.append(f"# backend render failed: {type(e).__name__}: {e}")
     return "\n".join(out) + ("\n" if out else "")

@@ -217,6 +217,66 @@ class MultiHeadAttention(Op):
         out = out.transpose(0, 2, 1, 3).reshape(B, S1, self.embed_dim)
         return [self._proj(params, out, "wo", "bo")], {"k": ck, "v": cv}
 
+    def init_paged_cache(self, num_blocks: int, block_size: int, dtype):
+        """Block-pool k/v storage shared by every slot: block id indexes
+        dim 0, so a slot's cache is whatever its block table names.
+        Block 0 is the garbage sink (serving/kvpool.py) — idle lanes
+        write and read it, masked."""
+        shp = (num_blocks, self.num_heads, block_size, self.head_dim)
+        return {"k": jnp.zeros(shp, dtype), "v": jnp.zeros(shp, dtype)}
+
+    def decode_paged(self, params, xs, cache, pos, tables, ctx):
+        """Single-token attention over a paged cache: scatter this
+        step's k/v into the block named by the row's table at
+        ``pos // block_size``, gather the W blocks of the table window
+        and attend over W*block_size positions (W is the static window
+        bucket the engine picked; positions past ``pos`` are masked with
+        the same -1e30 as the dense path, so softmax contributions are
+        exactly zero and greedy outputs stay bitwise-equal).
+
+        ``tables``: (B, W) int32 block ids; ``pos``: (B,) or scalar."""
+        q_in, k_in, v_in = xs
+        if q_in.shape[1] != 1 or k_in.shape[1] != 1:
+            raise ValueError(
+                f"decode_paged: op {self.name!r} got a full-sequence "
+                f"input; paged decode is single-token only")
+        if not self.causal:
+            raise ValueError(
+                f"decode_paged: op {self.name!r} is non-causal — "
+                f"not decodable")
+        B, S1, _ = q_in.shape
+        H, D = self.num_heads, self.head_dim
+        bs = cache["k"].shape[2]
+        W = tables.shape[1]
+        pos_v = pos if jnp.ndim(pos) else jnp.full((B,), pos, jnp.int32)
+        q = self._proj(params, q_in, "wq", "bq")
+        k = self._proj(params, k_in, "wk", "bk")
+        v = self._proj(params, v_in, "wv", "bv")
+        split = lambda t: t.reshape(B, S1, H, D).transpose(0, 2, 1, 3)
+        qh, kh, vh = split(q), split(k), split(v)            # (B, H, 1, D)
+        rows = jnp.arange(B)
+        bidx = tables[rows, pos_v // bs]                     # (B,)
+        roff = pos_v % bs
+        ck = cache["k"].at[bidx, :, roff, :].set(
+            kh[:, :, 0, :].astype(cache["k"].dtype))
+        cv = cache["v"].at[bidx, :, roff, :].set(
+            vh[:, :, 0, :].astype(cache["v"].dtype))
+        # window gather: (B, W, H, bs, D) -> (B, H, W*bs, D); table order
+        # is logical-block order, so the flat axis is position order
+        gk = ck[tables].transpose(0, 2, 1, 3, 4).reshape(B, H, W * bs, D)
+        gv = cv[tables].transpose(0, 2, 1, 3, 4).reshape(B, H, W * bs, D)
+        scale = 1.0 / math.sqrt(D)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                            gk.astype(jnp.float32)) * scale
+        valid = jnp.arange(W * bs)[None, None, None, :] \
+            <= pos_v[:, None, None, None]
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                         gv.astype(jnp.float32)).astype(q_in.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S1, self.embed_dim)
+        return [self._proj(params, out, "wo", "bo")], {"k": ck, "v": cv}
+
     def flops_per_sample(self):
         _, sq, e = self.output.dims
         sk = self.inputs[1].dims[1]

@@ -5,8 +5,10 @@ Layers:
 * ``config``  — ``ServeConfig`` / ``FF_SERVE_*`` env knobs (stdlib-only)
 * ``queue``   — ``InferenceRequest`` futures + priority ``RequestQueue``
                 (stdlib-only)
-* ``engine``  — ``InferenceEngine``: slot-based kv pool + the
-                continuous-batching decode loop (imports jax)
+* ``kvpool``  — ``KVBlockPool``: block-paged KV allocator — free list,
+                refcounts, prefix index, copy-on-write (stdlib-only)
+* ``engine``  — ``InferenceEngine``: paged (or dense-slot) kv pool +
+                the continuous-batching decode loop (imports jax)
 * ``pool``    — ``ReplicaPool``: N health-checked engine replicas
                 behind one admission queue — failover, load shedding,
                 hedging, graceful drain (imports jax via engine)
@@ -18,12 +20,13 @@ Layers:
 """
 
 from .config import ServeConfig
+from .kvpool import BlockExhausted, KVBlockPool
 from .queue import (InferenceRequest, RequestQueue, ServeError,
                     ServeOverload, ServeTimeout)
 
-__all__ = ["InferenceEngine", "InferenceRequest", "ReplicaPool",
-           "RequestQueue", "ServeConfig", "ServeError", "ServeOverload",
-           "ServeTimeout", "ServingAPI"]
+__all__ = ["BlockExhausted", "InferenceEngine", "InferenceRequest",
+           "KVBlockPool", "ReplicaPool", "RequestQueue", "ServeConfig",
+           "ServeError", "ServeOverload", "ServeTimeout", "ServingAPI"]
 
 
 def __getattr__(name):

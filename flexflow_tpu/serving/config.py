@@ -27,6 +27,20 @@ Knobs (env var -> field):
   FF_SERVE_HOST           host             HTTP bind host
   FF_SERVE_PORT           port             HTTP bind port (0: ephemeral)
 
+Paged-KV knobs (serving/kvpool.py; see docs/serving.md "Paged KV cache"):
+
+  FF_SERVE_PAGED          paged            "auto" (default: page whenever the
+                                           model's cache-carrying ops support
+                                           it), "on" (error if they don't),
+                                           "off" (dense slots, pre-paging
+                                           behavior)
+  FF_SERVE_KV_BLOCK       kv_block         KV block size in token positions;
+                                           must divide max_seq
+  FF_SERVE_KV_BLOCKS      kv_blocks        usable KV block budget shared by
+                                           all slots (0: auto =
+                                           max_batch * max_seq / kv_block,
+                                           the dense worst case)
+
 Replica-pool knobs (serving/pool.py; all inert for a bare engine):
 
   FF_SERVE_REPLICAS        replicas           engine replicas behind the one
@@ -96,6 +110,11 @@ class ServeConfig:
     host: str = "127.0.0.1"
     port: int = 8000
     # replica pool (inert for a bare InferenceEngine)
+    # paged KV cache (serving/kvpool.py)
+    paged: str = "auto"                # auto | on | off
+    kv_block: int = 16                 # positions per block
+    kv_blocks: int = 0                 # usable budget; 0 -> dense worst case
+    # replica pool (inert for a bare InferenceEngine)
     replicas: int = 1
     max_queue: int = 0                 # 0: unbounded (no shedding)
     shed_wait_s: float = 0.0           # 0: count-based shedding only
@@ -122,6 +141,17 @@ class ServeConfig:
             raise ValueError(
                 f"largest bucket {self.buckets[-1]} leaves no room for a "
                 f"generated token (max_seq={self.max_seq})")
+        if self.paged not in ("auto", "on", "off"):
+            raise ValueError(f"FF_SERVE_PAGED={self.paged!r} must be "
+                             f"'auto', 'on' or 'off'")
+        if self.kv_block < 1:
+            raise ValueError(f"kv_block must be >= 1, got {self.kv_block}")
+        if self.kv_blocks < 0:
+            raise ValueError(f"kv_blocks must be >= 0, got {self.kv_blocks}")
+        if self.paged == "on" and self.max_seq % self.kv_block:
+            raise ValueError(
+                f"FF_SERVE_KV_BLOCK={self.kv_block} must divide "
+                f"max_seq={self.max_seq} (or set FF_SERVE_PAGED=off)")
         if self.replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {self.replicas}")
         if self.max_queue < 0:
@@ -148,6 +178,9 @@ class ServeConfig:
                                        cls.queue_timeout_s),
             host=os.environ.get("FF_SERVE_HOST", cls.host),
             port=_env_int("FF_SERVE_PORT", cls.port, lo=0),
+            paged=os.environ.get("FF_SERVE_PAGED", cls.paged),
+            kv_block=_env_int("FF_SERVE_KV_BLOCK", cls.kv_block),
+            kv_blocks=_env_int("FF_SERVE_KV_BLOCKS", cls.kv_blocks, lo=0),
             replicas=_env_int("FF_SERVE_REPLICAS", cls.replicas),
             max_queue=_env_int("FF_SERVE_MAX_QUEUE", cls.max_queue, lo=0),
             shed_wait_s=_env_float("FF_SERVE_SHED_WAIT_S", cls.shed_wait_s),
@@ -189,6 +222,22 @@ class ServeConfig:
                 return b
         return None
 
+    def blocks_per_seq(self) -> int:
+        """KV blocks a worst-case (max_seq-long) sequence needs."""
+        return -(-self.max_seq // self.kv_block)
+
+    def paged_feasible(self) -> bool:
+        """Whether this config's geometry permits paging at all.  In
+        ``auto`` mode an incompatible geometry silently falls back to
+        dense (doctor flags it); ``on`` raised in __post_init__."""
+        return self.paged != "off" and self.max_seq % self.kv_block == 0
+
+    def kv_blocks_resolved(self) -> int:
+        """Effective usable block budget: the configured one, or the
+        dense worst case (every slot at max_seq) so paging is a strict
+        capacity superset by default."""
+        return self.kv_blocks or self.max_batch * self.blocks_per_seq()
+
     def validate_request(self, prompt_len: int, max_new_tokens: int) -> None:
         """Shape admission: raises ValueError when a request cannot fit
         this config (shared by the engine and the replica pool so both
@@ -217,8 +266,12 @@ class ServeConfig:
                     f"hedge={self.hedge_ms:g}ms "
                     f"restart_backoff={self.restart_backoff_s:g}s"
                     f"/{self.restart_cap_s:g}s")
+        kv = ""
+        if self.paged != "off":
+            kv = (f" paged={self.paged} kv_block={self.kv_block} "
+                  f"kv_blocks={self.kv_blocks_resolved()}")
         return (f"max_batch={self.max_batch} max_seq={self.max_seq} "
                 f"buckets={list(self.resolved_buckets())} "
                 f"max_new_tokens={self.max_new_tokens} "
                 f"queue_timeout={self.queue_timeout_s:g}s "
-                f"http={self.host}:{self.port}{pool}")
+                f"http={self.host}:{self.port}{kv}{pool}")

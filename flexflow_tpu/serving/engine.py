@@ -23,6 +23,21 @@ shape STATIC and move all dynamism to the host:
   static) but their causal masks zero their influence exactly, so greedy
   per-request output is equal to a standalone ``generate()`` call.
 
+Paged KV mode (default when the model qualifies — see FF_SERVE_PAGED):
+instead of a dense ``(max_batch, H, max_seq, D)`` slab per layer, the
+caches are block pools ``(num_blocks, H, block_size, D)`` addressed
+through per-slot int32 block tables (``serving/kvpool.py`` owns the
+host-side free list / refcounts / prefix index).  Admission gates on
+FREE BLOCKS, not just a free slot — exhaustion sheds with the existing
+``ServeOverload`` 503, never a crash — prompts sharing an indexed
+prefix skip to suffix prefill over the donor's chain (copy-on-write on
+the partial tail block), and each decode boundary runs the jitted step
+of the smallest WINDOW bucket covering the longest active sequence, so
+per-token attention reads scale with actual length, not ``max_seq``.
+All device shapes stay static: tables are a (B, W) argument, window
+buckets form a power-of-two ladder compiled once each, and idle lanes
+point at the never-allocated garbage block 0.
+
 Observability (when the model was compiled with telemetry): per-request
 ``serve_queue_wait`` / ``serve_prefill`` / ``serve_decode`` spans, a
 ``serve_request_done`` event carrying TTFT/TPOT, ``serve_tokens`` /
@@ -48,8 +63,9 @@ import numpy as np
 
 from ..testing.chaos import ChaosReplicaKill
 from .config import ServeConfig
-from .queue import (CANCELLED, DONE, ERROR, RUNNING, InferenceRequest,
-                    RequestQueue, ServeError)
+from .kvpool import BlockExhausted, KVBlockPool, blocks_for
+from .queue import (CANCELLED, DONE, ERROR, RUNNING, TIMEOUT,
+                    InferenceRequest, RequestQueue, ServeError)
 
 _engine_uids = itertools.count(1)
 
@@ -57,12 +73,14 @@ _engine_uids = itertools.count(1)
 class _Slot:
     """Host-side state of one running sequence."""
 
-    __slots__ = ("req", "pos", "t_first")
+    __slots__ = ("req", "pos", "t_first", "res")
 
-    def __init__(self, req: InferenceRequest, pos: int, t_first: float):
+    def __init__(self, req: InferenceRequest, pos: int, t_first: float,
+                 res=None):
         self.req = req
         self.pos = pos          # position the NEXT fed token occupies
         self.t_first = t_first
+        self.res = res          # kvpool.Reservation (paged mode only)
 
 
 class InferenceEngine:
@@ -125,6 +143,7 @@ class InferenceEngine:
         self._queue = queue if queue is not None else RequestQueue()
         self._owns_queue = queue is None
         self._admitting: Optional[InferenceRequest] = None
+        self._pending_admit: Optional[InferenceRequest] = None
         self._slots: List[Optional[_Slot]] = [None] * B
         self._toks = np.zeros(B, np.int32)   # last fed token per slot
         self._pos = np.zeros(B, np.int32)    # its position per slot
@@ -135,6 +154,29 @@ class InferenceEngine:
         # donation keeps the pooled caches in-place on accelerators; the
         # CPU backend would warn on every call
         self._donate = jax.default_backend() != "cpu"
+
+        # paged KV mode: geometry must divide AND every cache-carrying
+        # op must have a paged decode path; "on" makes a miss loud,
+        # "auto" falls back to the dense slot pool (LSTM stacks etc.)
+        cfg = self.config
+        if cfg.paged == "on" and not model.pageable_decode():
+            raise ValueError(
+                "FF_SERVE_PAGED=on but a cache-carrying op has no paged "
+                "decode path — serve this model with FF_SERVE_PAGED=off")
+        self._paged = cfg.paged_feasible() and model.pageable_decode()
+        self._kvpool: Optional[KVBlockPool] = None
+        if self._paged:
+            bs = cfg.kv_block
+            self._max_w = cfg.max_seq // bs  # window-bucket ceiling
+            shapes = jax.eval_shape(
+                lambda: model.init_paged_decode_caches(2, bs))
+            bytes_per_block = sum(
+                int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+                for leaf in jax.tree.leaves(shapes))
+            self._kvpool = KVBlockPool(cfg.kv_blocks_resolved() + 1, bs,
+                                       bytes_per_block)
+            self._paged_step_fns: Dict[int, Any] = {}
+            self._paged_prefill_fns: Dict[Any, Any] = {}
 
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -208,6 +250,91 @@ class InferenceEngine:
         return self._insert_fn
 
     # ------------------------------------------------------------------
+    # paged-mode jitted functions: one step per WINDOW bucket (W blocks
+    # gathered per row), one prefill per (gather-bucket, suffix-bucket)
+    # pair — the same compile-once-per-shape discipline as the dense
+    # ladder, with the block tables passed as a (B, W) int32 argument
+    # ------------------------------------------------------------------
+    def _block_bucket(self, n: int) -> int:
+        """Smallest power-of-two block count >= n (capped at the whole-
+        sequence window); 0 stays 0 (no gather)."""
+        if n <= 0:
+            return 0
+        w = 1
+        while w < n:
+            w *= 2
+        return min(w, self._max_w)
+
+    def _get_paged_step_fn(self, w: int):
+        fn = self._paged_step_fns.get(w)
+        if fn is None:
+            model, tok_t, pos_t = self.model, self._tok_t, self._pos_t
+
+            def step(params, stats, caches, toks, pos, tables):
+                probs, caches = model.decode_step(
+                    params, stats, caches, toks, pos, tok_t, pos_t,
+                    block_tables=tables)
+                return caches, jnp.argmax(probs, axis=-1).astype(jnp.int32)
+
+            fn = self._paged_step_fns[w] = jax.jit(
+                step, donate_argnums=(2,) if self._donate else ())
+        return fn
+
+    def _get_paged_prefill_fn(self, n_gb: int, sbucket: int):
+        """Gather -> dense scan -> scatter prefill.
+
+        The matched prefix's ``n_gb`` chain blocks (a power-of-two
+        bucket; unused entries name the garbage block) are gathered into
+        a dense scratch, the suffix runs the standard dense decode scan
+        over positions ``start + t``, and only the suffix's
+        ``nsc = ceil(sbucket/bs) + 1`` blocks (the +1 is the
+        copy-on-write partial tail) scatter back into the pool — an
+        8-token prompt moves one block per leaf, not a whole
+        ``(H, max_seq, D)`` slice."""
+        key = (n_gb, sbucket)
+        fn = self._paged_prefill_fns.get(key)
+        if fn is None:
+            model, tok_t, pos_t = self.model, self._tok_t, self._pos_t
+            bs = self.config.kv_block
+            sb_blocks = blocks_for(sbucket, bs)
+            nsc = sb_blocks + 1
+            from jax import lax
+
+            def prefill(params, stats, pool, gids, toks, start, d0, sids):
+                def gather(leaf):          # (N, H, bs, D) -> dense scratch
+                    h, d = leaf.shape[1], leaf.shape[3]
+                    g = leaf[gids].transpose(1, 0, 2, 3)
+                    g = g.reshape(1, h, n_gb * bs, d)
+                    z = jnp.zeros((1, h, nsc * bs, d), leaf.dtype)
+                    return jnp.concatenate([g, z], axis=2)
+
+                dense = jax.tree.map(gather, pool)
+
+                def body(dense, t):
+                    probs, dense = model.decode_step(
+                        params, stats, dense, toks[:, t], start + t,
+                        tok_t, pos_t)
+                    return dense, jnp.argmax(probs, -1).astype(jnp.int32)
+
+                dense, outs = lax.scan(body, dense, jnp.arange(sbucket))
+
+                def scatter(leaf, dbuf):
+                    h, d = leaf.shape[1], leaf.shape[3]
+                    nb = dbuf.shape[2] // bs
+                    blk = dbuf[0].reshape(h, nb, bs, d).transpose(1, 0, 2, 3)
+                    win = lax.dynamic_slice(
+                        blk, (d0, 0, 0, 0), (nsc,) + blk.shape[1:])
+                    return leaf.at[sids].set(win.astype(leaf.dtype))
+
+                pool = jax.tree.map(scatter, pool, dense)
+                return pool, outs[:, 0]
+
+            fn = self._paged_prefill_fns[key] = jax.jit(
+                prefill, donate_argnums=(2,) if self._donate else ())
+            self._stats["prefill_compiles"] += 1
+        return fn
+
+    # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def start(self) -> "InferenceEngine":
@@ -253,9 +380,9 @@ class InferenceEngine:
         pop and prefill must not lose that request).  Read by the pool's
         monitor from another thread — a snapshot, not a lock."""
         reqs = [s.req for s in self._slots if s is not None]
-        adm = self._admitting
-        if adm is not None and all(r is not adm for r in reqs):
-            reqs.append(adm)
+        for adm in (self._admitting, self._pending_admit):
+            if adm is not None and all(r is not adm for r in reqs):
+                reqs.append(adm)
         return [r for r in reqs if not r.done()]
 
     def __enter__(self) -> "InferenceEngine":
@@ -284,6 +411,11 @@ class InferenceEngine:
         if not self._accepting:
             raise ServeError("engine is not accepting requests "
                              "(not started, or stopping)")
+        if self._kvpool is not None:
+            # free-block admission control: shed (503 + Retry-After)
+            # when even evicting the whole prefix index couldn't cover
+            # this request's worst case on top of in-flight promises
+            self._kvpool.check_room(int(req.prompt.size), n)
         self._stats["submitted"] += 1
         self._queue.put(req)
         return req
@@ -307,6 +439,9 @@ class InferenceEngine:
         s["queued"] = self.num_queued
         s["mean_occupancy"] = (s["occupancy_sum"] / s["step_iterations"]
                                if s["step_iterations"] else 0.0)
+        s["paged"] = self._paged
+        if self._kvpool is not None:
+            s["kv"] = self._kvpool.stats()
         return s
 
     # ------------------------------------------------------------------
@@ -328,14 +463,28 @@ class InferenceEngine:
                 self._telemetry.flush()
             if self._owns_queue:
                 self._fail_outstanding(f"engine crashed: {self.crashed}")
+            elif self._paged:
+                # pool replica: its requests stay unresolved so the
+                # pool can fail them over, but this dead incarnation's
+                # block reservations must not dangle (release is
+                # idempotent; a later failover can't double-free)
+                for slot in self._slots:
+                    if slot is not None and slot.res is not None:
+                        self._kvpool.release(slot.res)
 
     def _fail_outstanding(self, msg: str) -> None:
         for i, slot in enumerate(self._slots):
             if slot is not None:
+                if slot.res is not None:
+                    self._kvpool.release(slot.res)
                 if slot.req._resolve(ERROR, msg):
                     self._stats["failed"] += 1
                     self._emit_done(slot.req)
                 self._slots[i] = None
+        parked, self._pending_admit = self._pending_admit, None
+        if parked is not None and parked._resolve(ERROR, msg):
+            self._stats["failed"] += 1
+            self._emit_done(parked)
         self._stats["failed"] += self._queue.drain(ERROR, msg)
 
     def _loop(self) -> None:
@@ -346,7 +495,8 @@ class InferenceEngine:
             if self._stop_evt.is_set():
                 if not self._drain:
                     break
-                if self.num_active == 0 and len(self._queue) == 0:
+                if self.num_active == 0 and len(self._queue) == 0 \
+                        and self._pending_admit is None:
                     break
             self._admit_ready(now)
             if self.num_active == 0:
@@ -365,8 +515,14 @@ class InferenceEngine:
         if self._owns_queue:
             self._stats["cancelled"] += self._queue.drain(
                 CANCELLED, "engine stopped")
+        parked, self._pending_admit = self._pending_admit, None
+        if parked is not None \
+                and parked._resolve(CANCELLED, "engine stopped"):
+            self._stats["cancelled"] += 1
         for i, slot in enumerate(self._slots):
             if slot is not None:
+                if slot.res is not None:
+                    self._kvpool.release(slot.res)
                 if slot.req._resolve(CANCELLED, "engine stopped"):
                     self._stats["cancelled"] += 1
                 self._slots[i] = None
@@ -377,7 +533,22 @@ class InferenceEngine:
                          if s is None), None)
             if free is None:
                 return
-            req = self._queue.pop_ready(now, avoid_key=self.uid)
+            req, self._pending_admit = self._pending_admit, None
+            if req is not None:
+                # parked at the last boundary (no free KV blocks):
+                # still honor cancellation and its queue-wait deadline
+                if req.done():
+                    continue
+                if req.timeout_s is not None \
+                        and now - req.t_submit > req.timeout_s:
+                    if req._resolve(TIMEOUT,
+                                    f"queue wait exceeded "
+                                    f"{req.timeout_s:g}s"):
+                        self._stats["timeouts"] += 1
+                        self._emit_done(req)
+                    continue
+            else:
+                req = self._queue.pop_ready(now, avoid_key=self.uid)
             if req is None:
                 return
             self._admitting = req
@@ -388,6 +559,13 @@ class InferenceEngine:
                 # loop thread dies; ``_admitting`` stays set so the pool
                 # fails this request over with the in-flight ones
                 raise
+            except BlockExhausted:
+                # blocks are all pinned by running sequences right now —
+                # park the head and retry once a boundary frees some;
+                # ordering is preserved (the park slot drains first)
+                self._admitting = None
+                self._pending_admit = req
+                return
             except Exception as e:  # noqa: BLE001 — isolate per request
                 req._resolve(ERROR, f"{type(e).__name__}: {e}")
                 self._stats["failed"] += 1
@@ -406,6 +584,9 @@ class InferenceEngine:
             self._chaos.fire("serve", model=self.model)
         req.t_admit = time.perf_counter()
         req.status = RUNNING
+        if self._paged:
+            self._admit_paged(req, slot)
+            return
         plen = int(req.prompt.size)
         bucket = self.config.bucket_for(plen)
         fn = self._get_prefill_fn(bucket)
@@ -442,15 +623,104 @@ class InferenceEngine:
         self._stats["max_active"] = max(self._stats["max_active"],
                                         self.num_active)
 
+    def _admit_paged(self, req: InferenceRequest, slot: int) -> None:
+        """Block-paged admission: reserve blocks (worst case promised so
+        decode can never starve), gather any indexed prefix chain, run
+        suffix-only prefill, scatter just the suffix's blocks into the
+        pool, and index this prompt for future sharers."""
+        pool = self._kvpool
+        cfg = self.config
+        bs = cfg.kv_block
+        plen = int(req.prompt.size)
+        res = pool.reserve(req.prompt, req.max_new_tokens)  # BlockExhausted
+        try:
+            m = res.hit_tokens                 # suffix starts here
+            slen = plen - m
+            sbucket = cfg.bucket_for(slen)
+            n_gb = self._block_bucket(blocks_for(m, bs))
+            nsc = blocks_for(sbucket, bs) + 1
+            gids = np.zeros(n_gb, np.int32)
+            gids[:len(res.gather)] = res.gather
+            sids = np.zeros(nsc, np.int32)
+            sids[:len(res.owned)] = res.owned
+            padded = np.zeros((1, sbucket), np.int32)
+            padded[0, :slen] = req.prompt[m:]
+            fn = self._get_paged_prefill_fn(n_gb, sbucket)
+            t0 = time.perf_counter()
+            params = self.model._decode_params()
+            if self._caches is None:
+                self._caches = self.model.init_paged_decode_caches(
+                    pool.num_blocks, bs)
+            self._caches, nexts = fn(
+                params, self.model._stats, self._caches,
+                jnp.asarray(gids), jnp.asarray(padded), jnp.int32(m),
+                jnp.int32(m // bs), jnp.asarray(sids))
+            first_tok = int(np.asarray(nexts)[slen - 1])
+            t1 = time.perf_counter()
+        except BaseException:
+            pool.release(res)                  # no leak on any failure
+            raise
+        pool.end_gather(res)
+        pool.note_transfer(nsc)
+        pool.note_gather(n_gb)
+        pool.register_prefix(req.prompt, res)
+
+        req.tokens.append(first_tok)
+        req.t_first = t1
+        self._stats["admitted"] += 1
+        log = self._telemetry
+        if log is not None:
+            log.span_at("serve_queue_wait", req.t_submit,
+                        req.t_admit - req.t_submit,
+                        request_id=req.request_id, priority=req.priority)
+            log.span_at("serve_prefill", t0, t1 - t0,
+                        request_id=req.request_id, prompt_len=plen,
+                        bucket=sbucket, slot=slot, replica=self.name)
+            if m > 0:
+                log.counter("serve_prefix_hits", 1)
+                log.counter("serve_prefill_tokens_saved", m)
+            else:
+                log.counter("serve_prefix_misses", 1)
+        if req.max_new_tokens == 1 or first_tok == req.eos_id:
+            pool.release(res)
+            self._finish(req, slot=None, t_done=t1)
+            return
+        self._slots[slot] = _Slot(req, plen, t_first=t1, res=res)
+        self._toks[slot] = first_tok
+        self._pos[slot] = plen
+        self._stats["max_active"] = max(self._stats["max_active"],
+                                        self.num_active)
+
     def _decode_iteration(self) -> None:
         """One token boundary: advance every slot one position.  Idle
         lanes compute too (static shapes) — their writes land in slots
         the next admission overwrites wholesale."""
         params = self.model._decode_params()
         try:
-            self._caches, nxt = self._get_step_fn()(
-                params, self.model._stats, self._caches,
-                jnp.asarray(self._toks), jnp.asarray(self._pos))
+            if self._paged:
+                # grow tables lazily (reservation-backed, cannot fail),
+                # then step at the smallest window bucket that covers
+                # the longest active row — FLOPs follow actual length
+                pool, bs = self._kvpool, self.config.kv_block
+                need_w = 1
+                for s in self._slots:
+                    if s is not None:
+                        pool.extend(s.res, s.pos)
+                        need_w = max(need_w, s.pos // bs + 1)
+                w = self._block_bucket(need_w)
+                tables = np.zeros((len(self._slots), w), np.int32)
+                for i, s in enumerate(self._slots):
+                    if s is not None:
+                        row = s.res.table()
+                        tables[i, :len(row)] = row
+                self._caches, nxt = self._get_paged_step_fn(w)(
+                    params, self.model._stats, self._caches,
+                    jnp.asarray(self._toks), jnp.asarray(self._pos),
+                    jnp.asarray(tables))
+            else:
+                self._caches, nxt = self._get_step_fn()(
+                    params, self.model._stats, self._caches,
+                    jnp.asarray(self._toks), jnp.asarray(self._pos))
             nxt = np.asarray(nxt)
         except Exception as e:  # noqa: BLE001 — a step fault kills the
             # BATCH's requests but never the loop: resolve them all and
@@ -462,6 +732,8 @@ class InferenceEngine:
             msg = f"decode step failed: {type(e).__name__}: {e}"
             for i, slot in enumerate(self._slots):
                 if slot is not None:
+                    if slot.res is not None:
+                        self._kvpool.release(slot.res)
                     slot.req._resolve(ERROR, msg)
                     self._stats["failed"] += 1
                     self._emit_done(slot.req)
@@ -474,6 +746,12 @@ class InferenceEngine:
         if self._telemetry is not None:
             self._telemetry.gauge("serve_batch_occupancy", active,
                                   replica=self.name)
+            if self._paged:
+                st = self._kvpool.stats()
+                self._telemetry.gauge("serve_kv_blocks_used",
+                                      st["blocks_used"], replica=self.name)
+                self._telemetry.counter("serve_decode_window", 1,
+                                        window=w * self.config.kv_block)
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -481,6 +759,8 @@ class InferenceEngine:
                 # resolved externally mid-decode (hedge loser force-
                 # cancelled, pool shutdown): free the lane; the next
                 # admission overwrites its cache slice wholesale
+                if slot.res is not None:
+                    self._kvpool.release(slot.res)
                 self._slots[i] = None
                 self._toks[i] = 0
                 self._pos[i] = 0
@@ -498,6 +778,9 @@ class InferenceEngine:
     def _finish(self, req: InferenceRequest, slot: Optional[int],
                 t_done: float) -> None:
         if slot is not None:
+            s = self._slots[slot]
+            if s is not None and s.res is not None:
+                self._kvpool.release(s.res)  # unused promise returns too
             self._slots[slot] = None
             self._toks[slot] = 0
             self._pos[slot] = 0

@@ -233,6 +233,25 @@ def _serving():
     if cfg.restart_backoff_s > cfg.restart_cap_s > 0:
         bits.append("WARN: FF_SERVE_RESTART_BACKOFF_S exceeds "
                     "FF_SERVE_RESTART_CAP_S (every restart waits the cap)")
+    if cfg.paged != "off":
+        # FF_SERVE_PAGED/KV_BLOCK/KV_BLOCKS: geometry problems surface
+        # here, not as a silent dense fallback at server start
+        if cfg.max_seq % cfg.kv_block:
+            bits.append(
+                f"ERROR: FF_SERVE_KV_BLOCK={cfg.kv_block} does not divide "
+                f"max_seq={cfg.max_seq} — paged KV falls back to dense "
+                f"(FF_SERVE_PAGED=on would refuse to start)")
+        else:
+            worst = cfg.max_batch * cfg.blocks_per_seq()
+            bits.append(f"paged kv: block={cfg.kv_block} budget="
+                        f"{cfg.kv_blocks_resolved()} blocks "
+                        + ("(FF_SERVE_KV_BLOCKS)" if cfg.kv_blocks
+                           else "(dense worst case)"))
+            if cfg.kv_blocks_resolved() < worst:
+                bits.append(
+                    f"WARN: FF_SERVE_KV_BLOCKS={cfg.kv_blocks} cannot hold "
+                    f"max_batch={cfg.max_batch} worst-case sequences "
+                    f"(need {worst}) — expect admission sheds at full load")
     probe_port = cfg.port if os.environ.get("FF_SERVE_PORT") else 0
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     try:

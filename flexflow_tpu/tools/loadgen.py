@@ -84,18 +84,43 @@ def _build_model(vocab: int, max_seq: int, train_iters: int, seed: int):
     return model
 
 
+def _len_ranges(len_dist: str, max_seq: int):
+    """Prompt-length ranges for --len-dist, scaled to max_seq.  The cap
+    at max_seq // 2 keeps every prompt inside the default power-of-two
+    bucket ladder (largest bucket is max_seq // 2)."""
+    short = (3, max(4, max_seq // 8))
+    long_ = (max(4, max_seq // 4), max(5, max_seq // 2 - 1))
+    return {"short": [short], "long": [long_],
+            "mixed": [short, long_]}[len_dist]
+
+
 def _make_requests(n: int, seed: int, vocab: int, prompt_lens: str,
-                   new_tokens: str):
+                   new_tokens: str, prefix_tokens: int = 0,
+                   len_dist: Optional[str] = None, max_seq: int = 64):
     import numpy as np
 
-    p_lo, p_hi = (int(x) for x in prompt_lens.split(":"))
     n_lo, n_hi = (int(x) for x in new_tokens.split(":"))
     rng = np.random.default_rng(seed)
+    if len_dist:
+        ranges = _len_ranges(len_dist, max_seq)
+    else:
+        p_lo, p_hi = (int(x) for x in prompt_lens.split(":"))
+        ranges = [(p_lo, p_hi)]
+    # the shared system prompt every request opens with (seeded
+    # separately so it is stable across --requests changes)
+    prefix = np.random.default_rng(seed + 7919).integers(
+        0, vocab, size=prefix_tokens).astype(np.int32)
+    cap = max_seq // 2                     # largest default bucket
     reqs = []
-    for _ in range(n):
-        plen = int(rng.integers(p_lo, p_hi + 1))
-        reqs.append((rng.integers(0, vocab, size=plen).astype(np.int32),
-                     int(rng.integers(n_lo, n_hi + 1))))
+    for i in range(n):
+        lo, hi = ranges[i % len(ranges)]
+        plen = int(rng.integers(lo, hi + 1))
+        plen = max(1, min(plen, cap - prefix_tokens))
+        prompt = np.concatenate(
+            [prefix, rng.integers(0, vocab, size=plen).astype(np.int32)])
+        new = int(rng.integers(n_lo, n_hi + 1))
+        new = max(1, min(new, max_seq - len(prompt)))
+        reqs.append((prompt, new))
     return reqs
 
 
@@ -178,7 +203,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max-seq", type=int, default=64)
     p.add_argument("--max-batch", type=int, default=4)
     p.add_argument("--prompt-lens", default="3:12", help="lo:hi inclusive")
+    p.add_argument("--len-dist", choices=("short", "mixed", "long"),
+                   default=None,
+                   help="prompt-length mix scaled to max_seq (overrides "
+                        "--prompt-lens): short|mixed|long — 'mixed' "
+                        "alternates short and long prompts, the "
+                        "workload paging helps most")
+    p.add_argument("--prefix-tokens", type=int, default=0,
+                   help="every prompt opens with this many SHARED "
+                        "tokens (a system prompt) — exercises the "
+                        "paged-KV prefix cache")
     p.add_argument("--new-tokens", default="8:24", help="lo:hi inclusive")
+    p.add_argument("--paged", choices=("auto", "on", "off"), default=None,
+                   help="paged KV mode (FF_SERVE_PAGED; default: env)")
+    p.add_argument("--kv-block", type=int, default=None,
+                   help="KV block size in positions (FF_SERVE_KV_BLOCK)")
+    p.add_argument("--kv-blocks", type=int, default=None,
+                   help="usable KV block budget (FF_SERVE_KV_BLOCKS; "
+                        "0: dense worst case)")
     p.add_argument("--train-iters", type=int, default=0,
                    help="train the toy model this many steps first")
     p.add_argument("--timeout", type=float, default=300.0,
@@ -201,11 +243,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     model = _build_model(args.vocab, args.max_seq, args.train_iters,
                          args.seed)
     reqs = _make_requests(args.requests, args.seed, args.vocab,
-                          args.prompt_lens, args.new_tokens)
+                          args.prompt_lens, args.new_tokens,
+                          prefix_tokens=args.prefix_tokens,
+                          len_dist=args.len_dist, max_seq=args.max_seq)
 
     from ..serving.api import ServingAPI
 
     max_new = max(int(args.new_tokens.split(":")[1]), 1)
+    kv_kw = {k: v for k, v in (("paged", args.paged),
+                               ("kv_block", args.kv_block),
+                               ("kv_blocks", args.kv_blocks))
+             if v is not None}
     if args.replicas > 1:
         from ..serving.config import ServeConfig
         from ..serving.pool import ReplicaPool
@@ -214,14 +262,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_batch=args.max_batch, max_seq=args.max_seq,
             max_new_tokens=max_new, replicas=args.replicas,
             max_queue=args.max_queue, hedge_ms=args.hedge_ms,
-            replica_timeout_s=args.replica_timeout)
+            replica_timeout_s=args.replica_timeout, **kv_kw)
         engine = ReplicaPool(model, config=scfg)
     else:
         from ..serving.engine import InferenceEngine
 
         engine = InferenceEngine(model, max_batch=args.max_batch,
                                  max_seq=args.max_seq,
-                                 max_new_tokens=max_new)
+                                 max_new_tokens=max_new, **kv_kw)
     results: List[Optional[dict]] = [None] * len(reqs)
     e2e: List[Optional[float]] = [None] * len(reqs)
     errors: List[str] = []
@@ -248,7 +296,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     detail = json.loads(e.read()).get("error", "")
                 except Exception:  # noqa: BLE001 — body is best-effort
                     pass
-                if e.code == 503 and detail.startswith("overloaded"):
+                if e.code == 503 and (
+                        detail.startswith("overloaded")
+                        or detail.startswith("kv blocks exhausted")):
                     # admission control working as designed, not a bug
                     with shed_lock:
                         n_shed += 1
@@ -312,10 +362,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         pool_stats = {k: stats[k] for k in
                       ("shed", "hedged", "failovers", "replica_downs",
                        "replica_restarts", "ready_replicas")}
+        kv_reps = [e["kv"] for e in per_rep if e.get("kv")]
+        paged = any(e.get("paged") for e in per_rep)
+        kv_stats = {
+            "blocks_peak": max([k["blocks_peak"] for k in kv_reps] or [0]),
+            "prefix_hits": sum(k["prefix_hits"] for k in kv_reps),
+            "prefix_misses": sum(k["prefix_misses"] for k in kv_reps),
+            "prefill_tokens_saved": sum(k["prefill_tokens_saved"]
+                                        for k in kv_reps),
+        } if kv_reps else None
     else:
         mean_occ = stats["mean_occupancy"]
         eng_stats = {k: stats[k] for k in eng_keys}
         pool_stats = None
+        paged = bool(stats.get("paged"))
+        kv_stats = stats.get("kv")
 
     ok = [r for r in results if r is not None]
     good = [i for i, r in enumerate(results)
@@ -347,6 +408,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             sum(len(r["tokens"]) for r in ok) / wall, 2) if wall > 0
         else 0.0,
         "mean_batch_occupancy": round(mean_occ, 3),
+        "paged": paged,
+        "prefix_tokens": args.prefix_tokens,
+        "len_dist": args.len_dist,
+        "kv_blocks_peak": kv_stats["blocks_peak"] if kv_stats else 0,
+        "prefix_hit_rate": round(
+            kv_stats["prefix_hits"]
+            / max(1, kv_stats["prefix_hits"] + kv_stats["prefix_misses"]),
+            4) if kv_stats else 0.0,
+        "prefill_tokens_saved": kv_stats["prefill_tokens_saved"]
+        if kv_stats else 0,
         "engine": eng_stats,
         "pool": pool_stats,
     }

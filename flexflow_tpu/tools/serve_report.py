@@ -53,6 +53,8 @@ def render_report(records: List[Dict[str, Any]],
     counters: Dict[str, float] = {}
     pool_events: List[Dict[str, Any]] = []   # replica pool lifecycle
     occ_by_rep: Dict[str, List[float]] = {}  # replica -> gauge values
+    kv_used: List[float] = []                # serve_kv_blocks_used gauge
+    window_mix: Dict[int, float] = {}        # decode window -> steps
     _POOL_EVENTS = ("replica_down", "replica_restart", "request_failover",
                     "request_hedged", "request_shed", "pool_drain")
     for r in records:
@@ -73,6 +75,11 @@ def render_report(records: List[Dict[str, Any]],
             admits.append(float(r.get("ts", 0.0)))
         elif t == "span" and name == "serve_decode":
             ends.append(float(r.get("ts", 0.0)) + float(r.get("dur", 0.0)))
+        elif t == "gauge" and name == "serve_kv_blocks_used":
+            kv_used.append(float(r.get("v", 0.0)))
+        elif t == "counter" and name == "serve_decode_window":
+            w = int(r.get("attrs", {}).get("window", 0))
+            window_mix[w] = window_mix.get(w, 0.0) + float(r.get("v", 1.0))
         elif t == "counter" and name and name.startswith("serve_"):
             counters[name] = r.get("total", r.get("v", 0.0))
 
@@ -159,6 +166,32 @@ def render_report(records: List[Dict[str, Any]],
                 bar = "#" * max(1, round(m * 2))
                 lines.append(f"| {lo:.2f}-{hi:.2f}s | {m:.2f} | `{bar}` |")
             lines.append("")
+
+    # ---- paged KV cache -----------------------------------------------
+    if kv_used or window_mix or "serve_prefix_hits" in counters \
+            or "serve_prefix_misses" in counters:
+        lines += ["## KV cache", ""]
+        if kv_used:
+            steady = sorted(kv_used)[len(kv_used) // 2]
+            lines.append(f"- block occupancy: peak {max(kv_used):g} · "
+                         f"median {steady:g} over {len(kv_used)} token "
+                         f"boundaries")
+        hits = counters.get("serve_prefix_hits", 0.0)
+        misses = counters.get("serve_prefix_misses", 0.0)
+        if hits or misses:
+            rate = hits / (hits + misses) if hits + misses else 0.0
+            lines.append(f"- prefix cache: {hits:g} hits / {misses:g} "
+                         f"misses ({rate:.0%} hit rate) · "
+                         f"{counters.get('serve_prefill_tokens_saved', 0):g}"
+                         f" prefill tokens skipped")
+        if window_mix:
+            total = sum(window_mix.values())
+            lines += ["", "| decode window (positions) | steps | share |",
+                      "|---|---|---|"]
+            for w in sorted(window_mix):
+                n = window_mix[w]
+                lines.append(f"| {w} | {n:g} | {n / total:.0%} |")
+        lines.append("")
 
     # ---- replicas (pool runs only) ------------------------------------
     if occ_by_rep or pool_events:

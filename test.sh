@@ -141,10 +141,40 @@ import json, sys
 b = json.load(open('$SMOKE_DIR/BENCH_SERVE.json'))
 print(f\"{b['achieved_tokens_s']} tok/s, occupancy {b['mean_batch_occupancy']}\")"))"
 
+# Paged-KV smoke: a shared 16-token system prompt across a mixed-length
+# request mix — the block-paged engine must reuse the cached prefix
+# (prefix_hit_rate > 0, prefill tokens actually skipped), stay bitwise
+# against one-shot generate(), and serve_report must fold the kv gauges
+# into its "## KV cache" section (docs/serving.md "Paged KV cache").
+PAGED_TRACE="$SMOKE_DIR/paged.jsonl"
+FF_TELEMETRY=1 FF_TELEMETRY_FILE="$PAGED_TRACE" \
+  python -m flexflow_tpu.tools.loadgen --requests 8 --concurrency 4 \
+    --seed 0 --prefix-tokens 16 --len-dist mixed --check-generate \
+    --out "$SMOKE_DIR/BENCH_PAGED.json" \
+  || { echo "paged smoke: loadgen failed (request error or greedy mismatch)"; exit 1; }
+python - "$SMOKE_DIR/BENCH_PAGED.json" <<'EOF' \
+  || { echo "paged smoke: BENCH_PAGED.json acceptance failed"; exit 1; }
+import json, sys
+b = json.load(open(sys.argv[1]))
+assert b["paged"] is True and b["n_ok"] == 8 and b["greedy_matches"] == 8, b
+assert b["prefix_hit_rate"] > 0, b["prefix_hit_rate"]
+assert b["prefill_tokens_saved"] > 0, b["prefill_tokens_saved"]
+assert b["kv_blocks_peak"] > 0, b["kv_blocks_peak"]
+EOF
+python -m flexflow_tpu.tools.serve_report "$PAGED_TRACE" \
+  | grep -q "## KV cache" \
+  || { echo "paged smoke: serve_report missing KV cache section"; exit 1; }
+echo "paged smoke: OK ($(python -c "
+import json
+b = json.load(open('$SMOKE_DIR/BENCH_PAGED.json'))
+print(f\"hit rate {b['prefix_hit_rate']}, \"
+      f\"{b['prefill_tokens_saved']} prefill tokens saved, \"
+      f\"peak {b['kv_blocks_peak']} blocks\")"))"
+
 # Metrics smoke: live /metrics while loadgen drives a 2-replica pool —
-# one mid-load scrape must return serving gauges (per-replica health)
-# AND training counters in valid Prometheus text
-# (docs/observability.md "Live metrics endpoint").
+# one mid-load scrape must return serving gauges (per-replica health,
+# paged-KV block occupancy) AND training counters in valid Prometheus
+# text (docs/observability.md "Live metrics endpoint").
 METRICS_PORT=9109
 METRICS_TRACE="$SMOKE_DIR/metrics_serve.jsonl"
 FF_TELEMETRY=1 FF_TELEMETRY_FILE="$METRICS_TRACE" \
@@ -157,7 +187,8 @@ python - "$METRICS_PORT" <<'EOF' \
   || { kill $LOADGEN_PID 2>/dev/null; echo "metrics smoke: scrape failed"; exit 1; }
 import re, sys, time, urllib.request
 url = f"http://127.0.0.1:{sys.argv[1]}/metrics"
-want = ("ff_replica_up", "ff_samples_total")   # serving + training series
+want = ("ff_replica_up", "ff_samples_total",   # serving + training series
+        "ff_serve_kv_blocks_used", "ff_serve_kv_blocks_free")  # paged KV
 sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eE]+$')
 deadline = time.time() + 180
 while time.time() < deadline:

@@ -1,0 +1,279 @@
+"""Block-paged KV cache (flexflow_tpu/serving/kvpool.py + paged engine).
+
+The load-bearing claims: paging the slot kv pool into refcounted
+fixed-size blocks is TRANSPARENT (every greedy output stays bitwise the
+tokens one-shot ``FFModel.generate()`` produces), admission moves only
+the prompt's own blocks instead of a whole max_seq slice, a shared
+prompt prefix is prefilled ONCE (later requests gather the cached
+chain and compute only their suffix — still bitwise-identical),
+copy-on-write keeps divergent continuations from corrupting each
+other, and block exhaustion is an admission shed (HTTP 503 +
+``Retry-After``), never a crash or a leaked block.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.transformer import build_transformer
+from flexflow_tpu.serving import ServeConfig, ServeOverload
+from flexflow_tpu.serving.engine import InferenceEngine
+from flexflow_tpu.serving.kvpool import (BlockExhausted, KVBlockPool,
+                                         blocks_for)
+from flexflow_tpu.serving.pool import ReplicaPool
+from flexflow_tpu.testing.chaos import ChaosMonkey
+
+V = 32          # vocab
+MAX_SEQ = 64    # default kv_block=16 -> 4 blocks per worst-case seq
+
+
+def _make_model(seed=3):
+    cfg = ff.FFConfig(batch_size=4)
+    m = ff.FFModel(cfg)
+    build_transformer(m, 4, seq_length=MAX_SEQ, num_layers=1,
+                      embed_dim=16, num_heads=2, vocab_size=V)
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=seed)
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _make_model()
+
+
+def _prompts(n, seed=0, lo=3, hi=28):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, V, size=int(rng.integers(lo, hi + 1)))
+            .astype(np.int32) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# pool unit: allocator / prefix index / reservation accounting
+# ---------------------------------------------------------------------------
+
+def test_kvpool_reserve_release_accounting():
+    pool = KVBlockPool(9, 16, bytes_per_block=1024)  # 8 usable + sink
+    toks = list(range(40))                           # 3 blocks
+    res = pool.reserve(toks, max_new=10)             # worst case 4
+    assert len(res.table()) == blocks_for(40, 16) == 3
+    assert res.promised == 1                         # ceil(50/16)=4 - 3
+    pool.register_prefix(toks, res)
+    pool.extend(res, pos=48)                         # crosses into block 4
+    assert len(res.table()) == 4
+    pool.release(res)
+    assert pool.slot_refs() == 0                     # index refs excluded
+    st = pool.stats()
+    assert st["blocks_promised"] == 0
+    # the index still pins the full prompt blocks for reuse
+    assert st["index_entries"] >= 1 and st["blocks_used"] >= 2
+
+    # a second identical prompt hits the exact-prompt entry
+    res2 = pool.reserve(toks, max_new=10)
+    assert res2.hit_tokens > 0 and pool.stats()["prefix_hits"] == 1
+    pool.end_gather(res2)
+    pool.release(res2)
+    assert pool.slot_refs() == 0
+
+
+def test_kvpool_exhaustion_sheds_not_crashes():
+    pool = KVBlockPool(3, 16, bytes_per_block=64)    # 2 usable blocks
+    with pytest.raises(BlockExhausted):
+        pool.check_room(40, 10)                      # needs 4 > 2
+    ok = pool.reserve(list(range(16)), max_new=8)    # needs 2: fits
+    with pytest.raises(BlockExhausted) as ei:
+        pool.reserve(list(range(100, 116)), max_new=8)
+    assert ei.value.retry_after_s > 0
+    assert pool.stats()["sheds"] >= 1
+    pool.release(ok)
+    assert pool.slot_refs() == 0
+
+
+# ---------------------------------------------------------------------------
+# bitwise greedy parity on mixed-length batches
+# ---------------------------------------------------------------------------
+
+def test_paged_greedy_parity_mixed_lengths(model):
+    prompts = _prompts(8, seed=1)
+    news = [6, 16, 4, 12, 9, 15, 8, 10]
+    eng = InferenceEngine(model, max_batch=4, max_seq=MAX_SEQ,
+                          max_new_tokens=32)
+    assert eng._paged, "paged mode should self-enable on this geometry"
+    with eng:
+        handles = [eng.submit(p, n) for p, n in zip(prompts, news)]
+        outs = [h.result(120) for h in handles]
+    for i, (p, n, got) in enumerate(zip(prompts, news, outs)):
+        assert np.array_equal(got, model.generate(p[None], n)[0]), i
+    st = eng.stats()
+    assert st["paged"] and st["kv"]["blocks_peak"] > 0
+    assert st["kv"]["blocks_promised"] == 0 and eng._kvpool.slot_refs() == 0
+
+
+# ---------------------------------------------------------------------------
+# admission moves only the prompt's blocks (satellite: no whole-slice copy)
+# ---------------------------------------------------------------------------
+
+def test_admission_transfers_only_prompt_blocks(model):
+    # 8-token prompt, block 16: the suffix bucket (8) spans
+    # ceil(8/16)+1 = 2 scatter blocks (the +1 absorbs an unaligned
+    # start).  The dense engine inserted a whole max_seq slice — 4
+    # blocks' worth — per admission regardless of prompt length; the
+    # transferred-bytes ledger must show the difference.
+    p = np.arange(8, dtype=np.int32) % V
+    eng = InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                          max_new_tokens=8)
+    with eng:
+        out = eng.submit(p, 6).result(120)
+    assert np.array_equal(out, model.generate(p[None], 6)[0])
+    st = eng.stats()["kv"]
+    bpb = eng._kvpool.bytes_per_block
+    dense_slice_bytes = (MAX_SEQ // st["block_size"]) * bpb
+    assert st["transferred_blocks"] == blocks_for(8, 16) + 1 == 2
+    assert st["transferred_bytes"] == 2 * bpb < dense_slice_bytes
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: warm admission is bitwise the cold one, suffix-only prefill
+# ---------------------------------------------------------------------------
+
+def test_prefix_hit_bitwise_identical_to_cold_prefill(model):
+    p = _prompts(1, seed=7, lo=24, hi=24)[0]        # 1 full + 1 partial
+    eng = InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                          max_new_tokens=16)
+    with eng:
+        cold = eng.submit(p, 10).result(120)        # registers the prefix
+        warm = eng.submit(p, 10).result(120)        # gathers it back
+        st = eng.stats()["kv"]
+    want = model.generate(p[None], 10)[0]
+    assert np.array_equal(cold, want)
+    assert np.array_equal(warm, cold)
+    assert st["prefix_hits"] >= 1 and st["prefix_hit_rate"] > 0
+    assert st["prefill_tokens_saved"] > 0
+    assert st["gathered_blocks"] >= 1
+
+
+def test_cow_divergence_after_shared_prefix(model):
+    # base prompt ends mid-block (24 = 16 + 8): continuations that hit
+    # its cached chain share the full block read-only but must COW the
+    # partial tail before writing their own suffix — and the donor's
+    # own generated tokens must never bleed into a sharer's output.
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, V, size=24).astype(np.int32)
+    ext_a = np.concatenate([base, np.array([1, 2], np.int32)])
+    ext_b = np.concatenate([base, np.array([3], np.int32)])
+    eng = InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                          max_new_tokens=16)
+    with eng:
+        outs = {}
+        outs["base"] = eng.submit(base, 12).result(120)
+        outs["a"] = eng.submit(ext_a, 12).result(120)
+        outs["b"] = eng.submit(ext_b, 12).result(120)
+        outs["base2"] = eng.submit(base, 12).result(120)
+        st = eng.stats()["kv"]
+    for key, prompt in (("base", base), ("a", ext_a), ("b", ext_b),
+                        ("base2", base)):
+        want = model.generate(prompt[None], 12)[0]
+        assert np.array_equal(outs[key], want), key
+    assert np.array_equal(outs["base2"], outs["base"])
+    assert st["prefix_hits"] >= 3
+    assert st["cow_copies"] >= 1, "partial-tail share never COWed"
+    assert eng._kvpool.slot_refs() == 0
+
+
+# ---------------------------------------------------------------------------
+# exhaustion under load: HTTP 503 + Retry-After, zero leaked blocks
+# ---------------------------------------------------------------------------
+
+def test_block_exhaustion_503_retry_after_no_leak(model):
+    from flexflow_tpu.serving.api import ServingAPI
+
+    # 2 usable blocks: one 20-token prompt + headroom promises both;
+    # a concurrent admission must shed at submit, not crash mid-decode
+    eng = InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                          max_new_tokens=8, kv_blocks=2)
+    p_big = np.arange(20, dtype=np.int32) % V       # ceil(28/16) = 2
+    with eng, ServingAPI(eng, port=0) as api:
+        h = eng.submit(p_big, 8)
+        body = json.dumps({"prompt": [int(t) for t in p_big],
+                           "max_new_tokens": 8}).encode()
+        req = urllib.request.Request(
+            f"{api.url}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        err = ei.value
+        assert err.code == 503
+        assert int(err.headers["Retry-After"]) >= 1
+        detail = json.loads(err.read()).get("error", "")
+        assert detail.startswith("kv blocks exhausted"), detail
+        # the in-flight request is untouched by the shed
+        assert np.array_equal(h.result(120),
+                              model.generate(p_big[None], 8)[0])
+        # drained: blocks all returned, and the SAME prompt now admits
+        assert eng._kvpool.slot_refs() == 0
+        out2 = eng.submit(p_big, 8).result(120)
+        assert np.array_equal(out2, model.generate(p_big[None], 8)[0])
+    st = eng.stats()["kv"]
+    assert st["sheds"] >= 1 and st["blocks_promised"] == 0
+    assert eng._kvpool.slot_refs() == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: a replica killed mid-flight leaves no dangling block refs
+# ---------------------------------------------------------------------------
+
+def test_refcounts_zero_after_chaos_replica_kill(model, monkeypatch):
+    # 3rd pool-wide admission raises ChaosReplicaKill inside whichever
+    # replica pops it; the dying loop must release every reservation it
+    # holds (in-flight slots AND the mid-admit request) before the pool
+    # fails its work over.
+    monkeypatch.setattr(model, "_chaos", ChaosMonkey("serve:3=replica_kill"))
+    prompts = _prompts(8, seed=2)
+    cfg = ServeConfig(max_batch=2, max_seq=MAX_SEQ, replicas=2,
+                      replica_timeout_s=120.0,
+                      restart_backoff_s=0.05, restart_cap_s=0.2)
+    engines = []
+    with ReplicaPool(model, config=cfg) as pool:
+        engines.extend(r.engine for r in pool._replicas)
+        handles = [pool.submit(p, 8) for p in prompts]
+        outs = [h.result(120) for h in handles]
+        st = pool.stats()
+        # restarted incarnations too (fresh engine objects)
+        engines.extend(r.engine for r in pool._replicas)
+    for i, (p, got) in enumerate(zip(prompts, outs)):
+        assert np.array_equal(got, model.generate(p[None], 8)[0]), i
+    assert st["replica_downs"] >= 1 and st["completed"] == 8
+    seen = {id(e): e for e in engines if e is not None}
+    assert len(seen) >= 3, "expected at least one restarted incarnation"
+    for e in seen.values():
+        if e._paged:
+            assert e._kvpool.slot_refs() == 0, e.uid
+            assert e._kvpool.stats()["blocks_promised"] == 0, e.uid
+
+
+# ---------------------------------------------------------------------------
+# capacity headline: equal block budget holds 2x the dense slot count
+# ---------------------------------------------------------------------------
+
+def test_paged_outadmits_dense_at_equal_budget(model):
+    # dense equivalent of max_batch=2 is 8 blocks (2 x 64/16).  With
+    # short prompts the paged engine keeps 4+ sequences' blocks live on
+    # that same budget — the dense pool by construction never exceeds 2.
+    eng = InferenceEngine(model, max_batch=4, max_seq=MAX_SEQ,
+                          max_new_tokens=8, kv_blocks=8)
+    prompts = _prompts(6, seed=5, lo=4, hi=10)
+    with eng:
+        handles = [eng.submit(p, 8) for p in prompts]
+        outs = [h.result(120) for h in handles]
+    for i, (p, got) in enumerate(zip(prompts, outs)):
+        assert np.array_equal(got, model.generate(p[None], 8)[0]), i
+    st = eng.stats()
+    assert st["max_active"] >= 4 > 2   # 2 = dense slots on this budget
+    assert eng._kvpool.slot_refs() == 0

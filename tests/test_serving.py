@@ -155,7 +155,12 @@ def test_bucketed_prefill_no_retrace(model):
               for n in (3, 4, 5, 7, 8)]
         for h in hs:
             h.result(120)
-    assert sorted(eng._prefill_fns) == [4, 8]
+    if eng._paged:
+        # paged prefill fns key on (gather-bucket, suffix-bucket); all
+        # cold admissions gather nothing, so the ladder is the same
+        assert sorted(eng._paged_prefill_fns) == [(0, 4), (0, 8)]
+    else:
+        assert sorted(eng._prefill_fns) == [4, 8]
     assert eng.stats()["prefill_compiles"] == 2
 
 
