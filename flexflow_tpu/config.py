@@ -170,6 +170,11 @@ class FFConfig:
     search_budget: int = 0
     search_alpha: float = 0.05
     search_overlap_backward_update: bool = False
+    # Search engine: "" = auto (native C++ anneal, falling back to the
+    # Python MCMC), "mcmc" = force the Python single chain, "population"
+    # = parallel-tempered population of delta-simulator chains
+    # (simulator/population.py; FF_SEARCH_* knobs tune it).
+    search_engine: str = ""
     # Also search pipeline stage assignments during compile() and apply
     # the plan when it beats the best dim strategy (set_pipeline).
     search_pipeline: bool = False
@@ -302,6 +307,8 @@ class FFConfig:
                 self.zero_optimizer = True
             elif a == "--search-pipeline":
                 self.search_pipeline = True
+            elif a == "--search-engine":
+                self.search_engine = take()
             elif a == "--grad-accum":
                 self.grad_accum_steps = int(take())
             elif a == "--remat":

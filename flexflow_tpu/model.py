@@ -871,13 +871,26 @@ class FFModel:
 
             mm = TPUMachineModel.calibrated(num_devices=self.machine.num_devices)
             best = None
-            r = native_mcmc_search(self, budget=cfg.search_budget,
-                                   alpha=cfg.search_alpha, machine_model=mm,
-                                   seed=cfg.seed,
-                                   overlap=cfg.search_overlap_backward_update,
-                                   verbose=False)
-            if r is not None:
-                best = r[0]
+            if cfg.search_engine == "population":
+                from .simulator.population import population_search
+
+                best = population_search(self, budget=cfg.search_budget,
+                                         alpha=cfg.search_alpha,
+                                         machine_model=mm, seed=cfg.seed,
+                                         verbose=False)
+            elif cfg.search_engine not in ("", "mcmc", "native"):
+                raise ValueError(
+                    f"unknown search_engine {cfg.search_engine!r} "
+                    "(expected '', 'native', 'mcmc', or 'population')")
+            if best is None and cfg.search_engine in ("", "native"):
+                r = native_mcmc_search(self, budget=cfg.search_budget,
+                                       alpha=cfg.search_alpha,
+                                       machine_model=mm,
+                                       seed=cfg.seed,
+                                       overlap=cfg.search_overlap_backward_update,
+                                       verbose=False)
+                if r is not None:
+                    best = r[0]
             if best is None:
                 from .simulator.search import mcmc_search
 
@@ -896,6 +909,9 @@ class FFModel:
                 "best_s": getattr(best, "best_s", None),
                 "dp_s": getattr(best, "dp_s", None),
                 "machine_model": mm,
+                # population engine: per-chain stats + learned-tier CV
+                # provenance ride into the exported sidecar
+                "search_stats": getattr(best, "stats", None),
             }
 
             # Stage-assignment search (--search-pipeline): when a GPipe
@@ -1095,6 +1111,14 @@ class FFModel:
             extra = {}
             if self.config.import_strategy_file:
                 extra["imported_from"] = self.config.import_strategy_file
+            if sp is not None and sp.get("search_stats"):
+                ss = sp["search_stats"]
+                extra["population"] = {k: ss[k] for k in
+                                       ("population", "ladder", "spent",
+                                        "winner_chain", "exchange",
+                                        "crossover") if k in ss}
+                if ss.get("learned"):
+                    extra["learned_tier"] = ss["learned"]
             if sp is None:
                 engine = "import" if self.config.import_strategy_file \
                     else "manual"

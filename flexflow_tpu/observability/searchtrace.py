@@ -118,10 +118,13 @@ class SearchRecorder:
     def candidate(self, it: int, op_name: str, old_pc, new_pc,
                   cur_ms: float, new_ms: float, best_ms: float,
                   accepted: bool, reason: str,
-                  prob: Optional[float] = None) -> None:
+                  prob: Optional[float] = None,
+                  **extra: Any) -> None:
         """One MCMC proposal.  ``reason``: "downhill" (new < current) or
         "metropolis" (uphill — accepted with probability ``prob``).
-        ``best_ms`` is the best-so-far AFTER this proposal."""
+        ``best_ms`` is the best-so-far AFTER this proposal.  ``extra``
+        attrs ride along verbatim (the population engine tags each
+        proposal with its ``chain``)."""
         self._proposals += 1
         st = self._op(op_name)
         st["proposals"] += 1
@@ -142,7 +145,42 @@ class SearchRecorder:
                  "accepted": bool(accepted), "reason": reason}
         if prob is not None:
             attrs["prob"] = round(float(prob), 6)
+        attrs.update(extra)
         self.log.event("search_candidate", **attrs)
+
+    # -- population-engine events ---------------------------------------
+    def exchange(self, it: int, pair: tuple, low_ms: float, high_ms: float,
+                 accepted: bool, prob: Optional[float] = None) -> None:
+        """One replica-exchange attempt between the adjacent-temperature
+        chains ``pair`` (colder chain first); ``low_ms``/``high_ms`` are
+        their current simulated costs before the swap."""
+        attrs = {"engine": self.engine, "iter": int(it),
+                 "chain_a": int(pair[0]), "chain_b": int(pair[1]),
+                 "a_ms": _r3(low_ms), "b_ms": _r3(high_ms),
+                 "accepted": bool(accepted)}
+        if prob is not None:
+            attrs["prob"] = round(float(prob), 6)
+        self.log.event("search_exchange", **attrs)
+
+    def crossover(self, it: int, parents: tuple, child_chain: int,
+                  patches: int, child_ms: Optional[float],
+                  adopted: bool) -> None:
+        """One genetic-crossover attempt: the elite ``parents`` spliced
+        into a child costed on ``child_chain`` via ``patches`` delta
+        patches; ``adopted`` marks whether the child replaced that
+        chain's state (the lineage the report reconstructs)."""
+        self.log.event("search_crossover", engine=self.engine,
+                       iter=int(it), parent_a=int(parents[0]),
+                       parent_b=int(parents[1]), chain=int(child_chain),
+                       patches=int(patches), child_ms=_r3(child_ms),
+                       adopted=bool(adopted))
+
+    def elite(self, it: int, ranking: list) -> None:
+        """Current population ranking at a crossover point:
+        ``ranking`` = [(chain, cur_ms)] best first."""
+        self.log.event("search_elite", engine=self.engine, iter=int(it),
+                       chains=[int(c) for c, _ in ranking],
+                       cur_ms=[_r3(m) for _, m in ranking])
 
     def plan(self, desc: str, cost_ms: float, accepted: bool,
              **attrs: Any) -> None:

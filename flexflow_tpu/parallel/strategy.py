@@ -260,6 +260,71 @@ def read_provenance(filename: str) -> Optional[Dict[str, Any]]:
         return None
 
 
+# Shipped strategy files (repo-root strategies/), the default scan
+# target for population-search warm starts.
+DEFAULT_STRATEGY_DIR = os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "strategies"))
+
+
+def load_warm_starts(model, num_devices: int,
+                     strategies_dir: Optional[str] = None,
+                     limit: Optional[int] = None
+                     ) -> List[Tuple[str, Dict[str, ParallelConfig]]]:
+    """Seed strategy maps for the population search: scan
+    ``strategies_dir`` (default: the shipped ``strategies/``) for ``.pb``
+    files whose ``.pb.meta.json`` provenance sidecars claim compatibility
+    with this model — every model op name present in the strategy map and
+    the sidecar's ``num_devices`` equal to ``num_devices``.  Returns
+    ``[(filename, {op: ParallelConfig})]`` in sorted filename order
+    (deterministic chain seeding).
+
+    A ``.pb`` without a sidecar is skipped silently (no provenance, no
+    compatibility claim); a sidecar whose content hash no longer matches
+    its ``.pb`` is skipped WITH a warning — a stale sidecar describes a
+    strategy that no longer exists, and warm-starting from it would
+    launder an unknown file through recorded provenance."""
+    out: List[Tuple[str, Dict[str, ParallelConfig]]] = []
+    d = DEFAULT_STRATEGY_DIR if strategies_dir is None else strategies_dir
+    if not os.path.isdir(d):
+        return out
+    op_names = {op.name for op in model.ops}
+    for fn in sorted(os.listdir(d)):
+        if not fn.endswith(".pb"):
+            continue
+        path = os.path.join(d, fn)
+        meta = read_provenance(path)
+        if meta is None:
+            continue
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        if meta.get("content_hash") != strategy_content_hash(data):
+            warnings.warn(f"skipping stale strategy sidecar {sidecar_path(path)}: "
+                          f"content hash no longer matches {fn}",
+                          stacklevel=2)
+            continue
+        try:
+            if int(meta.get("num_devices", -1)) != int(num_devices):
+                continue
+        except (TypeError, ValueError):
+            continue
+        try:
+            strategies = load_strategies_from_file(path)
+        except Exception as e:  # noqa: BLE001 — a bad file never breaks search
+            warnings.warn(f"skipping unreadable strategy file {path}: {e}",
+                          stacklevel=2)
+            continue
+        if not op_names.issubset(strategies):
+            continue
+        out.append((fn, {k: v for k, v in strategies.items()
+                         if k in op_names}))
+        if limit is not None and len(out) >= limit:
+            break
+    return out
+
+
 def _emit_provenance_event(filename: str, strategies: Dict[str, ParallelConfig],
                            data: bytes) -> None:
     # events.py is stdlib-only and active_log() is one dict lookup when

@@ -29,7 +29,7 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -37,6 +37,251 @@ from .machine import TPUMachineModel
 
 # Committed on-chip measurement cache, produced by tools/calibrate.py.
 MEASURED_CACHE = os.path.join(os.path.dirname(__file__), "measured_v5e.json")
+
+# Minimum measured points an op family needs before the learned tier will
+# even attempt a cross-validated fit (also the threshold tools/doctor.py
+# warns against when the learned tier is requested on a thin corpus).
+LEARNED_MIN_POINTS = 12
+LEARNED_FOLDS = 4
+
+
+def _parse_cost_key(key: str):
+    """Decompose a ``CostModel._key`` string back into
+    ``(family, sub, ins, extra, dtype, which)`` or None when the key is
+    not an op-timing key (the cache also holds e.g. ``host_xfer``
+    probes).  The key grammar has exactly six colon-separated fields and
+    tuples never contain colons, so a plain split is exact."""
+    import ast
+
+    parts = key.split(":")
+    if len(parts) != 6:
+        return None
+    fam, sub_s, ins_s, extra, dtype, which = parts
+    if which not in ("forward", "backward"):
+        return None
+    try:
+        sub = ast.literal_eval(sub_s)
+        ins = ast.literal_eval(ins_s) if ins_s else ()
+    except (ValueError, SyntaxError):
+        return None
+    if not isinstance(sub, tuple):
+        return None
+    return fam, sub, tuple(ins), extra, dtype, which
+
+
+def _key_flops_bytes(fam, sub, ins, extra, dtype_bytes):
+    """(flops, bytes) roofline estimate for one PART, reconstructed from
+    a cost-cache key alone — the featurization the learned tier shares
+    between fit time (corpus keys) and predict time (keys built by
+    ``CostModel._key``).  Weight volumes are approximated where the key
+    cannot carry them (Embedding tables)."""
+    out_elems = float(np.prod(sub)) if sub else 1.0
+    in_elems = float(sum(np.prod(s) for s in ins)) if ins else 0.0
+    kernel = stride = None
+    hidden = None
+    if extra.startswith("k"):
+        import ast
+        try:
+            kpart, spart = extra[1:].split("s", 1)
+            kernel = ast.literal_eval(kpart)
+            stride = ast.literal_eval(spart)
+        except (ValueError, SyntaxError):
+            pass
+    elif extra.startswith("h"):
+        try:
+            hidden = int(extra[1:])
+        except ValueError:
+            pass
+    weights = 0.0
+    if fam == "Conv2D" and kernel and ins:
+        cin = ins[0][-1]
+        flops = 2.0 * out_elems * kernel[0] * kernel[1] * cin
+        weights = float(kernel[0] * kernel[1] * cin * sub[-1] + sub[-1])
+    elif fam == "Pool2D" and kernel:
+        flops = out_elems * kernel[0] * kernel[1]
+    elif fam in ("Dense", "Linear") and ins:
+        in_dim = ins[0][-1]
+        flops = 2.0 * out_elems * in_dim
+        weights = float(in_dim * sub[-1] + sub[-1])
+    elif fam == "Embedding":
+        flops = out_elems
+        weights = out_elems  # rows actually touched ≈ batch × out_dim
+    elif fam == "LSTM" and hidden and ins and len(ins[0]) == 3:
+        b, t, e = ins[0]
+        flops = 2.0 * b * t * (e + hidden) * 4 * hidden
+        weights = float(4 * hidden * (e + hidden + 1))
+    elif fam == "MultiHeadAttention" and ins:
+        flops = 8.0 * out_elems * (1.0 + ins[0][-1] / max(1, sub[-1]))
+    else:
+        # elementwise-ish fallback: one MAC per output element against
+        # the innermost input width
+        flops = 2.0 * out_elems * (ins[0][-1] if ins and ins[0] else 1)
+    bytes_moved = dtype_bytes * (in_elems + weights + out_elems)
+    return float(flops), float(bytes_moved)
+
+
+class LearnedCostTier:
+    """Per-op-family regression over the measured-timing corpus.
+
+    Fits ``log t ≈ w · [1, log1p(flops), log1p(bytes), is_backward]``
+    per family (numpy lstsq — stdlib + numpy only) on every measured
+    entry whose key parses, then k-fold cross-validates the fit AGAINST
+    the key-level analytic roofline: a family's learned model is used
+    only when its out-of-fold log-RMSE strictly beats the analytic
+    model's on the same folds.  Families below ``LEARNED_MIN_POINTS``
+    measured points never fit.  The full account — per-family point
+    counts, both OOF errors, used/rejected — lands in ``provenance``
+    so a search that priced candidates with learned costs can say so
+    (ISSUE 15 / ``FF_SEARCH_LEARNED`` escape hatch in the engines).
+    """
+
+    def __init__(self, machine: TPUMachineModel,
+                 compute_dtype: str = "float32",
+                 corpus: Optional[Dict[str, float]] = None,
+                 folds: int = LEARNED_FOLDS,
+                 min_points: int = LEARNED_MIN_POINTS,
+                 sources: Optional[Dict[str, int]] = None):
+        self.machine = machine
+        self.compute_dtype = compute_dtype
+        self._dtype_bytes = 2.0 if "16" in compute_dtype else 4.0
+        self._models: Dict[str, np.ndarray] = {}
+        corpus = corpus or {}
+        by_fam: Dict[str, list] = {}
+        for key, t in sorted(corpus.items()):
+            parsed = _parse_cost_key(key)
+            if parsed is None or not (t > 0):
+                continue
+            fam, sub, ins, extra, _dtype, which = parsed
+            fl, by = _key_flops_bytes(fam, sub, ins, extra,
+                                      self._dtype_bytes)
+            feats = (1.0, np.log1p(fl), np.log1p(by),
+                     1.0 if which == "backward" else 0.0)
+            by_fam.setdefault(fam, []).append(
+                (feats, float(np.log(t)),
+                 float(np.log(self._analytic_key(fam, fl, by, which)))))
+        families: Dict[str, Any] = {}
+        for fam, rows in sorted(by_fam.items()):
+            n = len(rows)
+            rep: Dict[str, Any] = {"points": n}
+            if n < min_points:
+                rep["used"] = False
+                rep["reason"] = f"corpus below fit threshold ({n} < {min_points})"
+                families[fam] = rep
+                continue
+            X = np.asarray([r[0] for r in rows], np.float64)
+            y = np.asarray([r[1] for r in rows], np.float64)
+            ya = np.asarray([r[2] for r in rows], np.float64)
+            k = min(folds, n)
+            # deterministic index-order folds: corpus iteration is sorted
+            # by key, so the split (and therefore used/rejected and every
+            # downstream search decision) is bitwise run-to-run stable
+            idx = np.arange(n)
+            err_l, err_a = [], []
+            for f in range(k):
+                test = idx[f::k]
+                train = np.setdiff1d(idx, test)
+                w, *_ = np.linalg.lstsq(X[train], y[train], rcond=None)
+                err_l.extend((X[test] @ w - y[test]).tolist())
+                err_a.extend((ya[test] - y[test]).tolist())
+            rmse_l = float(np.sqrt(np.mean(np.square(err_l))))
+            rmse_a = float(np.sqrt(np.mean(np.square(err_a))))
+            rep["oof_log_rmse_learned"] = round(rmse_l, 4)
+            rep["oof_log_rmse_analytic"] = round(rmse_a, 4)
+            rep["folds"] = int(k)
+            if rmse_l < rmse_a:
+                w, *_ = np.linalg.lstsq(X, y, rcond=None)
+                self._models[fam] = w
+                rep["used"] = True
+            else:
+                rep["used"] = False
+                rep["reason"] = "analytic roofline wins out-of-fold"
+            families[fam] = rep
+        self.provenance: Dict[str, Any] = {
+            "tier": "learned",
+            "corpus_points": int(sum(len(r) for r in by_fam.values())),
+            "min_points": int(min_points),
+            "families": families,
+            "used_families": sorted(self._models),
+        }
+        if sources:
+            self.provenance["sources"] = dict(sources)
+
+    def _analytic_key(self, fam: str, flops: float, bytes_moved: float,
+                      which: str) -> float:
+        """Key-level roofline — the CV baseline.  Mirrors
+        ``CostModel._analytic`` with the weight volume approximated from
+        the key (the op object is not available at fit time)."""
+        m = self.machine
+        eff = m.op_efficiency.get(fam, m.mxu_efficiency)
+        t = max(flops / (m.peak_flops * eff),
+                bytes_moved / m.hbm_bandwidth) + m.kernel_launch_overhead
+        if which == "backward":
+            t *= m.op_backward_multiplier.get(fam, m.backward_multiplier)
+        return float(t)
+
+    def predict(self, key: str) -> Optional[float]:
+        """Predicted seconds for a cost-cache key, or None when the key's
+        family did not win its cross-validation (caller falls through to
+        the analytic roofline)."""
+        parsed = _parse_cost_key(key)
+        if parsed is None:
+            return None
+        fam, sub, ins, extra, _dtype, which = parsed
+        w = self._models.get(fam)
+        if w is None:
+            return None
+        fl, by = _key_flops_bytes(fam, sub, ins, extra, self._dtype_bytes)
+        x = np.asarray((1.0, np.log1p(fl), np.log1p(by),
+                        1.0 if which == "backward" else 0.0), np.float64)
+        return float(np.exp(x @ w))
+
+    @classmethod
+    def fit_default(cls, machine: TPUMachineModel,
+                    compute_dtype: str = "float32",
+                    measured_cache_path: Optional[str] = None,
+                    ledger_path: Optional[str] = None) -> "LearnedCostTier":
+        """Fit on the accumulating corpus: the committed
+        ``measured_v5e.json`` plus any per-op timings calibration
+        sessions have appended to ``PERF_LEDGER.jsonl`` (entries whose
+        provenance carries an ``op_times`` map)."""
+        corpus: Dict[str, float] = {}
+        sources: Dict[str, int] = {}
+        path = measured_cache_path or MEASURED_CACHE
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                n0 = len(corpus)
+                for k, v in data.items():
+                    if isinstance(v, dict) and v.get("measured"):
+                        corpus[k] = float(v["t"])
+                sources[os.path.basename(path)] = len(corpus) - n0
+            except Exception:
+                pass
+        if ledger_path is None:
+            from ..tools import perf_ledger
+            ledger_path = perf_ledger.default_path()
+        if ledger_path and os.path.exists(ledger_path):
+            n0 = len(corpus)
+            try:
+                with open(ledger_path) as f:
+                    for line in f:
+                        try:
+                            entry = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        ops = (entry.get("provenance") or {}).get("op_times")
+                        if isinstance(ops, dict):
+                            for k, t in ops.items():
+                                try:
+                                    corpus[k] = float(t)
+                                except (TypeError, ValueError):
+                                    continue
+            except OSError:
+                pass
+            sources[os.path.basename(ledger_path)] = len(corpus) - n0
+        return cls(machine, compute_dtype=compute_dtype, corpus=corpus,
+                   sources=sources)
 
 
 class CostModel:
@@ -53,7 +298,11 @@ class CostModel:
         self._measured: Dict[str, float] = {}
         self._analytic_memo: Dict[str, float] = {}
         self._measure_failed: set = set()  # don't re-compile known failures
-        self.stats = {"measured_hits": 0, "measured_runs": 0, "analytic": 0}
+        self.stats = {"measured_hits": 0, "measured_runs": 0,
+                      "learned": 0, "analytic": 0}
+        # optional learned regression tier (LearnedCostTier), consulted
+        # between the measured cache and the analytic roofline
+        self._learned: Optional["LearnedCostTier"] = None
         # op_time fast path: the string _key is canonical but costs more
         # to BUILD than a memoized lookup saves, so hot callers (the
         # delta simulator re-costing thousands of proposals) hit this
@@ -302,6 +551,14 @@ class CostModel:
         return float(t)
 
     # -- public ------------------------------------------------------------
+    def attach_learned_tier(self, tier: Optional["LearnedCostTier"]) -> None:
+        """Install (or clear) the learned regression tier.  Must happen
+        before any costing: the ``op_time`` fast path memoizes results,
+        so a tier attached mid-run would only affect never-seen keys."""
+        assert not self._fast, \
+            "attach_learned_tier must precede the first op_time call"
+        self._learned = tier
+
     def op_time(self, op, pc, which: str) -> float:
         fk = (id(op), pc, which)
         hit = self._fast.get(fk)
@@ -334,6 +591,11 @@ class CostModel:
                 # a repeat call would find it in _measured
                 return t, "measured_hits"
             self._measure_failed.add(key)
+        if self._learned is not None:
+            t = self._learned.predict(key)
+            if t is not None:
+                self.stats["learned"] += 1
+                return t, "learned"
         self.stats["analytic"] += 1
         if key not in self._analytic_memo:
             self._analytic_memo[key] = self._analytic(op, pc, which)

@@ -191,7 +191,8 @@ class DeltaSimulator:
     """
 
     def __init__(self, sim: Simulator, model,
-                 strategies: Optional[Dict[str, ParallelConfig]] = None):
+                 strategies: Optional[Dict[str, ParallelConfig]] = None,
+                 share_caches_from: Optional["DeltaSimulator"] = None):
         self.sim = sim
         self.model = model
         self.machine = sim.machine
@@ -216,20 +217,40 @@ class DeltaSimulator:
             self._inc[li].append(k)
             if pi != li:
                 self._inc[pi].append(k)
-        self._node_memo: Dict[Tuple, _NodeFrag] = {}
-        self._edge_memo: Dict[Tuple, _EdgeFrag] = {}
-        self._vol_memo: Dict[Tuple, list] = {}
-        self._upd_memo: Dict[Tuple, _UpdFrag] = {}
-        self._legal_memo: Dict[Tuple, ParallelConfig] = {}
-        self._tt_memo: Dict[Tuple, float] = {}  # (src, dst, vol) -> s
-        # Legalized configs are INTERNED (one canonical object per value,
-        # pinned for the simulator's lifetime), so fragment memos key on
-        # cheap (index, id) tuples instead of re-hashing dataclasses, and
-        # a whole-strategy result memo collapses revisited states — late
-        # anneals re-propose the same (op, config) from the same plan
-        # constantly — to a single dict hit.
-        self._intern: Dict[ParallelConfig, ParallelConfig] = {}
-        self._result_memo: Dict[Tuple[int, ...], float] = {}
+        if share_caches_from is not None:
+            # Population chains: N DeltaSimulators over the SAME
+            # (sim, model) pair share every memo dict — fragment keys are
+            # (op index, interned-config id) tuples, identical across
+            # chains, so one chain's costing work is every chain's cache
+            # hit.  Committed per-chain state (_cur/_cnfs/...) stays
+            # private below.
+            donor = share_caches_from
+            assert donor.sim is sim and donor.model is model, \
+                "shared delta caches require the same Simulator and model"
+            self._node_memo = donor._node_memo
+            self._edge_memo = donor._edge_memo
+            self._vol_memo = donor._vol_memo
+            self._upd_memo = donor._upd_memo
+            self._legal_memo = donor._legal_memo
+            self._tt_memo = donor._tt_memo
+            self._intern = donor._intern
+            self._result_memo = donor._result_memo
+        else:
+            self._node_memo: Dict[Tuple, _NodeFrag] = {}
+            self._edge_memo: Dict[Tuple, _EdgeFrag] = {}
+            self._vol_memo: Dict[Tuple, list] = {}
+            self._upd_memo: Dict[Tuple, _UpdFrag] = {}
+            self._legal_memo: Dict[Tuple, ParallelConfig] = {}
+            self._tt_memo: Dict[Tuple, float] = {}  # (src, dst, vol) -> s
+            # Legalized configs are INTERNED (one canonical object per
+            # value, pinned for the simulator's lifetime), so fragment
+            # memos key on cheap (index, id) tuples instead of re-hashing
+            # dataclasses, and a whole-strategy result memo collapses
+            # revisited states — late anneals re-propose the same
+            # (op, config) from the same plan constantly — to a single
+            # dict hit.
+            self._intern: Dict[ParallelConfig, ParallelConfig] = {}
+            self._result_memo: Dict[Tuple[int, ...], float] = {}
         self._bar_rt = np.zeros(self.nd, np.float64)
         self._bar_dev = np.arange(self.nd, dtype=np.int64)
         # Global base-vector layout: one start index per task block —

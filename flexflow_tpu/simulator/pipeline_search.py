@@ -301,13 +301,17 @@ def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
 def suggest_parallelization(model, budget: Optional[int] = None,
                             machine_model: Optional[TPUMachineModel] = None,
                             seed: int = 0,
-                            microbatches: Optional[int] = None) -> Dict:
+                            microbatches: Optional[int] = None,
+                            engine: str = "") -> Dict:
     """Search BOTH spaces — per-op SOAP dims and pipeline stage
     assignment — and return the faster plan:
 
         {"kind": "dims"|"pipeline", "simulated_s": t,
          "strategies": {...} | "pipeline": {...},
          "alternatives": {"dims_s": t1, "pipeline_s": t2}}
+
+    ``engine`` selects the dim searcher: "" (auto: native then mcmc),
+    "mcmc", or "population" (simulator/population.py).
     """
     from ..config import DEFAULT_SEARCH_BUDGET
     from .native_search import native_mcmc_search
@@ -316,6 +320,9 @@ def suggest_parallelization(model, budget: Optional[int] = None,
 
     if budget is None:
         budget = DEFAULT_SEARCH_BUDGET
+    if engine not in ("", "mcmc", "native", "population"):
+        raise ValueError(f"unknown search engine {engine!r} "
+                         "(expected '', 'mcmc', 'native' or 'population')")
     nd = model.machine.num_devices if model.machine is not None \
         else model.config.num_devices
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
@@ -324,10 +331,17 @@ def suggest_parallelization(model, budget: Optional[int] = None,
     sim = Simulator(mm, cost)
 
     best_dims = None
-    r = native_mcmc_search(model, budget=budget, machine_model=mm,
-                           seed=seed, verbose=False)
-    if r is not None:
-        best_dims = r[0]
+    if engine == "population":
+        from .population import population_search
+
+        best_dims = population_search(model, budget=budget,
+                                      machine_model=mm, seed=seed,
+                                      verbose=False, cost_model=cost)
+    elif engine in ("", "native"):
+        r = native_mcmc_search(model, budget=budget, machine_model=mm,
+                               seed=seed, verbose=False)
+        if r is not None:
+            best_dims = r[0]
     if best_dims is None:
         # share this function's CostModel so the anneal reuses the memo
         # caches the pipeline grid pass is about to warm (and vice versa)

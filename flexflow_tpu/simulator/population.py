@@ -1,0 +1,504 @@
+"""Population-based strategy search: parallel-tempered delta chains.
+
+The paper's search (Jia et al., "Beyond Data and Model Parallelism",
+§5.3) anneals ONE Markov chain.  PR 7 made each proposal cost ~1 graph
+patch; this module spends that throughput on a POPULATION of
+communicating chains over the same total proposal budget:
+
+  * N ``DeltaSimulator`` chains, each owning its committed-fragment
+    state but SHARING the process-wide memo caches (node/edge/update
+    fragments, tile-intersection volumes, transfer times, interned
+    configs, whole-state results) — one chain's costing work is every
+    chain's cache hit, so N chains cost barely more than one.
+  * Parallel tempering: a temperature ladder over the existing MCMC
+    ``alpha`` (chain 0 coldest = base alpha; hotter chains accept more
+    uphill moves and roam), with seeded periodic replica-exchange swaps
+    between adjacent temperatures accepted at the standard
+    ``min(1, exp((a_k - a_j) * (E_k - E_j)))`` (costs in the same ms
+    scale the Metropolis rule uses).  Exchanges cost ZERO budget: both
+    states are already in the shared result memo.
+  * Periodic genetic crossover: the two elite (lowest-cost) chains
+    splice their per-op ``ParallelConfig`` maps into a child, re-costed
+    via the delta patch path one op at a time — a child with K spliced
+    ops costs exactly K patches (charged against the shared budget),
+    never a graph rebuild.  The child replaces the worst chain only
+    when strictly better.
+  * Heterogeneous warm starts: chain 0 from the data-parallel default,
+    the next chains from shipped ``strategies/*.pb`` whose
+    ``.pb.meta.json`` provenance sidecars match this model's op names
+    and device count (``parallel.strategy.load_warm_starts``), the rest
+    from seeded random restarts.
+
+Everything is driven by seeded RNGs in a fixed order, so a seeded run
+is bitwise-reproducible (pinned by tests/test_population_search.py).
+Knobs come from the environment (``FF_SEARCH_*``, validated loudly —
+``tools/doctor.py`` has a "search" section for them) or an explicit
+``PopulationKnobs``.
+
+The learned cost tier (``cost_model.LearnedCostTier``) is ON by default
+for this engine — it only ever replaces the analytic roofline for op
+families that beat it under k-fold cross-validation — and OFF for the
+single-chain engine, whose seeded results must stay bitwise-identical
+across releases.  ``FF_SEARCH_LEARNED=0`` disables it everywhere,
+``FF_SEARCH_LEARNED=1`` forces it on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..config import ParallelConfig
+from .cost_model import CostModel, LearnedCostTier
+from .machine import TPUMachineModel
+from .search import (SearchResult, _delta_enabled, random_parallel_config)
+from .simulator import Simulator
+
+DEFAULT_POPULATION = 8
+DEFAULT_LADDER_RATIO = 0.65
+DEFAULT_EXCHANGE_EVERY = 50
+DEFAULT_CROSSOVER_EVERY = 150
+
+
+def _env_int(env: Dict[str, str], name: str, default: int,
+             minimum: int) -> int:
+    raw = env.get(name, "")
+    if raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer >= {minimum}, "
+                         f"got {raw!r}") from None
+    if v < minimum:
+        raise ValueError(f"{name} must be >= {minimum}, got {v}")
+    return v
+
+
+def parse_learned_flag(raw: str) -> Optional[bool]:
+    """``FF_SEARCH_LEARNED`` tri-state: unset -> engine default, 0/1 ->
+    forced.  Anything else is a loud error (doctor's search section)."""
+    if raw == "":
+        return None
+    low = raw.lower()
+    if low in ("0", "false", "off"):
+        return False
+    if low in ("1", "true", "on"):
+        return True
+    raise ValueError(f"FF_SEARCH_LEARNED must be 0 or 1, got {raw!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class PopulationKnobs:
+    """Population-engine tuning, env-overridable:
+
+    ``FF_SEARCH_POPULATION``  chains (int >= 2; default 8)
+    ``FF_SEARCH_LADDER``      temperature ladder over alpha: a single
+                              ratio r in (0, 1] (chain k gets
+                              alpha * r**k) or an explicit comma list of
+                              per-chain multipliers (len == population)
+    ``FF_SEARCH_EXCHANGE``    rounds between replica-exchange sweeps
+                              (int >= 0; 0 disables; default 50)
+    ``FF_SEARCH_CROSSOVER``   rounds between crossover attempts
+                              (int >= 0; 0 disables; default 150)
+    ``FF_SEARCH_LEARNED``     learned cost tier: unset = engine default
+                              (on for population, off for mcmc), 0/1
+                              forces
+    """
+
+    population: int = DEFAULT_POPULATION
+    ladder_ratio: float = DEFAULT_LADDER_RATIO
+    ladder: Tuple[float, ...] = ()   # explicit multipliers; () = geometric
+    exchange_every: int = DEFAULT_EXCHANGE_EVERY
+    crossover_every: int = DEFAULT_CROSSOVER_EVERY
+    learned: Optional[bool] = None
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "PopulationKnobs":
+        env = os.environ if env is None else env
+        population = _env_int(env, "FF_SEARCH_POPULATION",
+                              DEFAULT_POPULATION, 2)
+        ratio = DEFAULT_LADDER_RATIO
+        ladder: Tuple[float, ...] = ()
+        raw = env.get("FF_SEARCH_LADDER", "")
+        if raw:
+            try:
+                vals = tuple(float(x) for x in raw.split(","))
+            except ValueError:
+                raise ValueError(
+                    "FF_SEARCH_LADDER must be a ratio in (0, 1] or a "
+                    f"comma list of positive multipliers, got {raw!r}"
+                ) from None
+            if any(v <= 0 for v in vals):
+                raise ValueError(
+                    f"FF_SEARCH_LADDER entries must be > 0, got {raw!r}")
+            if len(vals) == 1:
+                if vals[0] > 1:
+                    raise ValueError("FF_SEARCH_LADDER ratio must be in "
+                                     f"(0, 1], got {vals[0]}")
+                ratio = vals[0]
+            else:
+                if len(vals) != population:
+                    raise ValueError(
+                        f"FF_SEARCH_LADDER lists {len(vals)} multipliers "
+                        f"but FF_SEARCH_POPULATION is {population}")
+                ladder = vals
+        exchange_every = _env_int(env, "FF_SEARCH_EXCHANGE",
+                                  DEFAULT_EXCHANGE_EVERY, 0)
+        crossover_every = _env_int(env, "FF_SEARCH_CROSSOVER",
+                                   DEFAULT_CROSSOVER_EVERY, 0)
+        learned = parse_learned_flag(env.get("FF_SEARCH_LEARNED", ""))
+        return cls(population=population, ladder_ratio=ratio, ladder=ladder,
+                   exchange_every=exchange_every,
+                   crossover_every=crossover_every, learned=learned)
+
+    def alphas(self, alpha: float) -> Tuple[float, ...]:
+        if self.ladder:
+            return tuple(alpha * m for m in self.ladder)
+        return tuple(alpha * self.ladder_ratio ** k
+                     for k in range(self.population))
+
+
+class _FullChainSim:
+    """``DeltaSimulator``-protocol adapter over full re-simulation —
+    the FF_SIM_DELTA=0 escape hatch keeps working for the population
+    engine (same reset/propose/commit/rollback surface, every cost a
+    full rebuild)."""
+
+    def __init__(self, sim: Simulator, model):
+        self.sim = sim
+        self.model = model
+        self._cur: Dict[str, ParallelConfig] = {}
+        self._pending = None
+
+    def reset(self, strategies: Dict[str, ParallelConfig]) -> float:
+        self._cur = dict(strategies)
+        self._pending = None
+        return self.sim.simulate_runtime(self.model, self._cur)
+
+    def propose(self, op_name: str, pc: ParallelConfig) -> float:
+        old = self._cur[op_name]
+        self._cur[op_name] = pc
+        rt = self.sim.simulate_runtime(self.model, self._cur)
+        self._cur[op_name] = old
+        self._pending = (op_name, pc)
+        return rt
+
+    def commit(self) -> None:
+        if self._pending is not None:
+            self._cur[self._pending[0]] = self._pending[1]
+            self._pending = None
+
+    def rollback(self) -> None:
+        self._pending = None
+
+
+class _Chain:
+    __slots__ = ("ci", "alpha", "rng", "delta", "cur", "cur_rt",
+                 "best_rt", "seed_kind", "proposals", "accepted",
+                 "exchanges", "adopted")
+
+    def __init__(self, ci: int, alpha: float, rng: random.Random,
+                 delta, seed_kind: str):
+        self.ci = ci
+        self.alpha = alpha
+        self.rng = rng
+        self.delta = delta
+        self.seed_kind = seed_kind
+        self.cur: Dict[str, ParallelConfig] = {}
+        self.cur_rt = float("inf")
+        self.best_rt = float("inf")
+        self.proposals = 0
+        self.accepted = 0
+        self.exchanges = 0
+        self.adopted = 0
+
+
+def population_search(model, budget: int, alpha: float = 0.05,
+                      machine_model: Optional[TPUMachineModel] = None,
+                      seed: int = 0,
+                      overlap_backward_update: Optional[bool] = None,
+                      verbose: bool = True,
+                      cost_model: Optional[CostModel] = None,
+                      num_devices: Optional[int] = None,
+                      knobs: Optional[PopulationKnobs] = None
+                      ) -> SearchResult:
+    """Population search over the SAME total proposal budget a
+    single-chain ``mcmc_search(budget)`` would spend: every chain
+    proposal and every crossover patch is charged against ``budget``,
+    so ``search_bench --mode quality`` compares the two engines at
+    genuinely equal cost.  Returns a ``SearchResult`` with
+    ``engine="population"``, per-chain stats in ``.chains`` and run
+    stats (ladder, exchange acceptance by temperature pair, crossover
+    lineage, learned-tier provenance) in ``.stats``."""
+    knobs = knobs if knobs is not None else PopulationKnobs.from_env()
+    nd = int(num_devices) if num_devices is not None \
+        else (model.machine.num_devices if model.machine is not None
+              else model.config.num_devices)
+    mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
+    overlap = model.config.search_overlap_backward_update \
+        if overlap_backward_update is None else overlap_backward_update
+    cost = cost_model if (cost_model is not None and cost_model.machine is mm) \
+        else CostModel(mm, measure=False,
+                       compute_dtype=model.config.compute_dtype,
+                       target_platform="tpu")
+    # Learned tier: on by default for THIS engine (cross-validation
+    # gates each family), forced either way by FF_SEARCH_LEARNED.
+    learned_prov = None
+    use_learned = True if knobs.learned is None else knobs.learned
+    if use_learned:
+        tier = LearnedCostTier.fit_default(
+            mm, compute_dtype=model.config.compute_dtype)
+        learned_prov = tier.provenance
+        if tier.provenance["used_families"]:
+            try:
+                cost.attach_learned_tier(tier)
+            except AssertionError:
+                # caller handed a pre-warmed CostModel: keep its costs
+                # (and say so) rather than mixing tiers mid-memo
+                learned_prov = dict(tier.provenance)
+                learned_prov["attached"] = False
+    sim = Simulator(mm, cost, overlap_backward_update=overlap)
+
+    P = knobs.population
+    alphas = knobs.alphas(alpha)
+    master = random.Random((seed + 1) * 0x9E3779B1)
+
+    def chain_sim(donor):
+        if _delta_enabled():
+            try:
+                from .delta import DeltaSimulator
+                return DeltaSimulator(sim, model, share_caches_from=donor)
+            except Exception:
+                pass
+        return _FullChainSim(sim, model)
+
+    donor = None
+    chains: List[_Chain] = []
+    for ci in range(P):
+        cs = chain_sim(donor)
+        if donor is None and not isinstance(cs, _FullChainSim):
+            donor = cs
+        chains.append(_Chain(ci, alphas[ci],
+                             random.Random((seed + 1) * 1_000_003 + ci),
+                             cs, "random"))
+    delta_on = donor is not None
+
+    # -- heterogeneous warm starts --------------------------------------
+    dp = {op.name: ParallelConfig.data_parallel(op.output.num_dims, nd)
+          .with_device_ids(tuple(range(nd)))
+          for op in model.ops}
+    from ..parallel.strategy import load_warm_starts
+    warm = load_warm_starts(model, nd)
+    chains[0].cur = dict(dp)
+    chains[0].seed_kind = "dp"
+    for i, ch in enumerate(chains[1:]):
+        if i < len(warm):
+            label, strategies = warm[i]
+            ch.cur = dict(dp)
+            ch.cur.update(strategies)
+            ch.seed_kind = f"sidecar:{label}"
+        else:
+            ch.cur = {op.name: op.legalize_pc(
+                random_parallel_config(op, nd, ch.rng, model=model))
+                for op in model.ops}
+            ch.seed_kind = "random"
+    for ch in chains:
+        ch.cur_rt = ch.delta.reset(ch.cur)
+        ch.best_rt = ch.cur_rt
+    dp_rt = chains[0].cur_rt
+
+    best = dict(min(chains, key=lambda c: (c.cur_rt, c.ci)).cur)
+    best_rt = min(ch.cur_rt for ch in chains)
+
+    import contextlib
+
+    from ..observability.events import active_log
+    from ..observability.searchtrace import SearchRecorder
+    tel = active_log()
+    rec = SearchRecorder.maybe("population", budget, nd, seed, log=tel)
+    if rec is not None:
+        rec.start(initial_ms=dp_rt * 1e3)
+    span = tel.span("population_search", budget=budget, num_devices=nd,
+                    population=P) if tel is not None \
+        else contextlib.nullcontext({})
+
+    exchange_stats: Dict[str, Dict[str, int]] = {}
+    cross_stats = {"attempts": 0, "adopted": 0, "patches": 0}
+    lineage: List[Dict] = []
+    spent = 0
+    round_idx = 0
+    t0 = time.perf_counter()
+
+    def note_best(state: Dict[str, ParallelConfig], rt: float):
+        nonlocal best, best_rt
+        if rt < best_rt:
+            best_rt = rt
+            best = dict(state)
+
+    with span as span_attrs:
+        while spent < budget:
+            for ch in chains:
+                if spent >= budget:
+                    break
+                op = ch.rng.choice(model.ops)
+                old_pc = ch.cur[op.name]
+                new_pc = op.legalize_pc(
+                    random_parallel_config(op, nd, ch.rng, model=model))
+                nxt_rt = ch.delta.propose(op.name, new_pc)
+                spent += 1
+                ch.proposals += 1
+                if nxt_rt < best_rt:
+                    nxt_state = dict(ch.cur)
+                    nxt_state[op.name] = new_pc
+                    note_best(nxt_state, nxt_rt)
+                if nxt_rt < ch.cur_rt:
+                    accepted, reason, prob = True, "downhill", None
+                else:
+                    prob = math.exp(-ch.alpha * (nxt_rt - ch.cur_rt) * 1e3)
+                    accepted, reason = ch.rng.random() < prob, "metropolis"
+                if rec is not None:
+                    rec.candidate(spent - 1, op.name, old_pc, new_pc,
+                                  cur_ms=ch.cur_rt * 1e3,
+                                  new_ms=nxt_rt * 1e3,
+                                  best_ms=best_rt * 1e3, accepted=accepted,
+                                  reason=reason, prob=prob, chain=ch.ci)
+                if accepted:
+                    ch.cur[op.name] = new_pc
+                    ch.cur_rt = nxt_rt
+                    ch.best_rt = min(ch.best_rt, nxt_rt)
+                    ch.accepted += 1
+                    ch.delta.commit()
+                else:
+                    ch.delta.rollback()
+            round_idx += 1
+            if verbose and round_idx % 100 == 0:
+                print(f"round({round_idx}) spent({spent}/{budget}) "
+                      f"best({best_rt * 1e3:.3f}ms) "
+                      f"chains({', '.join(f'{c.cur_rt * 1e3:.2f}' for c in chains)})")
+            if tel is not None and round_idx % 100 == 0:
+                tel.event("search_progress", engine="population",
+                          iter=spent, best_ms=round(best_rt * 1e3, 3))
+
+            # -- replica exchange (free: both states are memoized) ------
+            if knobs.exchange_every and \
+                    round_idx % knobs.exchange_every == 0:
+                for k in range(P - 1):
+                    a, b = chains[k], chains[k + 1]
+                    # min(1, exp((a_k - a_j) (E_k - E_j))) in the same
+                    # ms scale the Metropolis rule uses; the colder
+                    # chain has the larger alpha, so a hotter chain
+                    # holding a BETTER state always swaps down.
+                    log_p = (a.alpha - b.alpha) \
+                        * (a.cur_rt - b.cur_rt) * 1e3
+                    prob = 1.0 if log_p >= 0 else math.exp(log_p)
+                    ok = log_p >= 0 or master.random() < prob
+                    st = exchange_stats.setdefault(
+                        f"{k}<->{k + 1}", {"attempts": 0, "accepts": 0})
+                    st["attempts"] += 1
+                    if rec is not None:
+                        rec.exchange(spent, (a.ci, b.ci),
+                                     a.cur_rt * 1e3, b.cur_rt * 1e3,
+                                     accepted=ok, prob=prob)
+                    if ok:
+                        st["accepts"] += 1
+                        a.cur, b.cur = b.cur, a.cur
+                        a.cur_rt, b.cur_rt = b.cur_rt, a.cur_rt
+                        a.cur_rt = a.delta.reset(a.cur)
+                        b.cur_rt = b.delta.reset(b.cur)
+                        a.best_rt = min(a.best_rt, a.cur_rt)
+                        b.best_rt = min(b.best_rt, b.cur_rt)
+                        a.exchanges += 1
+                        b.exchanges += 1
+
+            # -- genetic crossover (child costs exactly K patches) ------
+            if knobs.crossover_every and P >= 3 and \
+                    round_idx % knobs.crossover_every == 0 and \
+                    spent < budget:
+                ranked = sorted(chains, key=lambda c: (c.cur_rt, c.ci))
+                pa, pb = ranked[0], ranked[1]
+                worst = ranked[-1]
+                if rec is not None:
+                    rec.elite(spent, [(c.ci, c.cur_rt * 1e3)
+                                      for c in ranked])
+                diff = [name for name in pa.cur
+                        if pa.cur[name] != pb.cur[name]]
+                splice = [name for name in diff if master.random() < 0.5]
+                if splice and spent + len(splice) <= budget:
+                    cross_stats["attempts"] += 1
+                    saved_cur, saved_rt = worst.cur, worst.cur_rt
+                    child = dict(pa.cur)
+                    rt = worst.delta.reset(pa.cur)  # memoized: free
+                    for name in splice:
+                        rt = worst.delta.propose(name, pb.cur[name])
+                        worst.delta.commit()
+                        spent += 1
+                        child[name] = pb.cur[name]
+                        note_best(child, rt)
+                    cross_stats["patches"] += len(splice)
+                    adopted = rt < saved_rt
+                    if adopted:
+                        cross_stats["adopted"] += 1
+                        worst.cur, worst.cur_rt = child, rt
+                        worst.best_rt = min(worst.best_rt, rt)
+                        worst.adopted += 1
+                        lineage.append({
+                            "iter": spent, "parents": [pa.ci, pb.ci],
+                            "chain": worst.ci, "patches": len(splice),
+                            "child_ms": round(rt * 1e3, 3)})
+                    else:
+                        worst.cur, worst.cur_rt = saved_cur, saved_rt
+                        worst.cur_rt = worst.delta.reset(saved_cur)
+                    if rec is not None:
+                        rec.crossover(spent, (pa.ci, pb.ci), worst.ci,
+                                      len(splice), rt * 1e3,
+                                      adopted=adopted)
+
+        dt = time.perf_counter() - t0
+        proposals_per_s = spent / dt if dt > 0 else 0.0
+        span_attrs["best_ms"] = round(best_rt * 1e3, 3)
+        span_attrs["proposals_per_s"] = round(proposals_per_s, 1)
+
+    winner = min(chains, key=lambda c: (c.best_rt, c.ci))
+    chain_stats = [{
+        "chain": ch.ci, "alpha": round(ch.alpha, 6),
+        "seed": ch.seed_kind, "proposals": ch.proposals,
+        "accepted": ch.accepted, "exchanges": ch.exchanges,
+        "crossovers_adopted": ch.adopted,
+        "best_ms": round(ch.best_rt * 1e3, 4),
+        "cur_ms": round(ch.cur_rt * 1e3, 4),
+    } for ch in chains]
+    stats = {
+        "population": P,
+        "ladder": [round(a, 6) for a in alphas],
+        "exchange_every": knobs.exchange_every,
+        "crossover_every": knobs.crossover_every,
+        "spent": spent,
+        "winner_chain": winner.ci,
+        "exchange": exchange_stats,
+        "crossover": cross_stats,
+        "lineage": lineage,
+        "learned": learned_prov,
+        "delta_sim": delta_on,
+    }
+    if rec is not None:
+        rec.finish(best, best_ms=best_rt * 1e3,
+                   proposals_per_s=proposals_per_s, delta=delta_on)
+    if tel is not None:
+        tel.flush()
+    if verbose:
+        print("=========== Best Discovered Strategy (population) ======")
+        for name, pc in best.items():
+            print(f"[{name}] dims{list(pc.dims)} parts({pc.num_parts()})")
+        print(f"simulated runtime: {best_rt * 1e3:.3f} ms/iter "
+              f"(dp {dp_rt * 1e3:.3f} ms; {P} chains, "
+              f"{spent} proposals)")
+    return SearchResult(best, engine="population", budget=budget,
+                        seed=seed, num_devices=nd, best_s=best_rt,
+                        dp_s=dp_rt, proposals_per_s=proposals_per_s,
+                        delta_sim=delta_on, chains=chain_stats,
+                        stats=stats)

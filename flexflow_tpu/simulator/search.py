@@ -127,7 +127,9 @@ class SearchResult(Dict[str, ParallelConfig]):
                  num_devices: int = 0, best_s: Optional[float] = None,
                  dp_s: Optional[float] = None,
                  proposals_per_s: Optional[float] = None,
-                 delta_sim: Optional[bool] = None):
+                 delta_sim: Optional[bool] = None,
+                 chains: Optional[list] = None,
+                 stats: Optional[Dict] = None):
         super().__init__(strategies)
         self.engine = engine
         self.budget = budget
@@ -138,6 +140,11 @@ class SearchResult(Dict[str, ParallelConfig]):
         # throughput telemetry only — never part of result equality
         self.proposals_per_s = proposals_per_s
         self.delta_sim = delta_sim
+        # population engine only: per-chain stat dicts + run-level stats
+        # (tempering ladder, exchange acceptance, crossover lineage,
+        # learned-tier provenance) — None for single-chain engines
+        self.chains = chains
+        self.stats = stats
 
 
 def _delta_enabled() -> bool:

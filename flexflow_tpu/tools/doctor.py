@@ -265,6 +265,45 @@ def _serving():
     return ", ".join(bits)
 
 
+def _search():
+    # Effective FF_SEARCH_* env as simulator/population.py will see it —
+    # a typo'd knob fails HERE (ValueError in the detail) instead of at
+    # the first population_search call — plus a learned-tier corpus
+    # probe: the tier is requested (or on by engine default) but no op
+    # family clears the fit threshold, so searches silently price
+    # everything analytically.
+    from ..simulator.cost_model import LEARNED_MIN_POINTS, LearnedCostTier
+    from ..simulator.machine import TPUMachineModel
+    from ..simulator.population import PopulationKnobs
+
+    knobs = PopulationKnobs.from_env()  # ValueError on a bad knob
+    ladder = (",".join(f"{m:g}" for m in knobs.ladder) if knobs.ladder
+              else f"ratio {knobs.ladder_ratio:g}")
+    bits = [f"FF_SEARCH_POPULATION={knobs.population}",
+            f"ladder {ladder}",
+            f"exchange every {knobs.exchange_every or 'off'}",
+            f"crossover every {knobs.crossover_every or 'off'}",
+            "FF_SEARCH_LEARNED=" + ("auto (population only)"
+                                    if knobs.learned is None
+                                    else "on" if knobs.learned else "off")]
+    if knobs.learned is not False:
+        tier = LearnedCostTier.fit_default(
+            TPUMachineModel.calibrated(num_devices=8))
+        prov = tier.provenance
+        if not prov["used_families"]:
+            bits.append(f"WARN: learned tier "
+                        f"{'forced on' if knobs.learned else 'enabled'} but "
+                        f"no family clears it (corpus "
+                        f"{prov['corpus_points']} points, need "
+                        f"{LEARNED_MIN_POINTS}/family AND a CV win) — "
+                        f"searches price analytically")
+        else:
+            bits.append(f"learned tier: "
+                        f"{', '.join(prov['used_families'])} win CV "
+                        f"(corpus {prov['corpus_points']} points)")
+    return ", ".join(bits)
+
+
 def _perf(probe: bool):
     # The perf observatory's state at a glance: is a chip reachable
     # right now (subprocess, 10s cap — never hangs the doctor), how much
@@ -369,6 +408,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              ("observability", _observability, False),
              ("metrics", _metrics, False),
              ("perf", lambda: _perf(probe=not args.skip_accelerator), False),
+             ("search", _search, False),
              ("resilience", _resilience, False),
              ("reconfiguration", _reconfiguration, False),
              ("serving", _serving, False),

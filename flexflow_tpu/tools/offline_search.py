@@ -79,8 +79,12 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--alpha", type=float, default=0.05)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--export", default=None, help="strategy .pb output path")
-    p.add_argument("--engine", choices=["native", "python"], default="native",
-                   help="native C++ annealing engine (falls back to python)")
+    p.add_argument("--engine", choices=["native", "python", "population"],
+                   default="native",
+                   help="native C++ annealing engine (falls back to "
+                        "python), or the parallel-tempered population "
+                        "engine (simulator/population.py; FF_SEARCH_* "
+                        "knobs tune it)")
     p.add_argument("--consider-pipeline", action="store_true",
                    help="also search pipeline stage assignments "
                         "(simulator/pipeline_search.py) and report when a "
@@ -119,7 +123,13 @@ def main(argv: Optional[List[str]] = None):
     dp_rt = sim.simulate_runtime(model, dp)
 
     best = None
-    if args.engine == "native":
+    if args.engine == "population":
+        from ..simulator.population import population_search
+
+        best = population_search(model, budget=args.budget,
+                                 alpha=args.alpha, machine_model=mm,
+                                 seed=args.seed, verbose=not args.quiet)
+    elif args.engine == "native":
         from ..simulator.native_search import native_mcmc_search
 
         r = native_mcmc_search(model, budget=args.budget, alpha=args.alpha,
@@ -163,12 +173,21 @@ def main(argv: Optional[List[str]] = None):
         from ..observability.searchtrace import build_provenance
         from ..parallel.strategy import sidecar_path
 
+        extra = {"model": args.model, "tool": "offline_search"}
+        stats = getattr(best, "stats", None)
+        if stats:
+            extra["population"] = {k: stats[k] for k in
+                                   ("population", "ladder", "spent",
+                                    "winner_chain", "exchange",
+                                    "crossover") if k in stats}
+            if stats.get("learned"):
+                extra["learned_tier"] = stats["learned"]
         prov = build_provenance(
             model, dict(best),
             engine=getattr(best, "engine", args.engine),
             budget=args.budget, seed=args.seed,
             best_s=best_rt, dp_s=dp_rt, machine_model=mm,
-            extra={"model": args.model, "tool": "offline_search"})
+            extra=extra)
         save_strategies_to_file(args.export, best, provenance=prov)
         print(f"exported strategy -> {args.export} "
               f"(+ {sidecar_path(args.export)})")

@@ -157,10 +157,12 @@ def last_good(entries: Optional[List[Dict]] = None,
 def _group_key(e: Dict) -> Tuple:
     # Entries are only comparable within the same metric/backend/mode and
     # benchmark config: a batch-256 number dropping below a batch-1024
-    # number is a config change, not a regression.
+    # number is a config change, not a regression — and neither is a
+    # transformer search_quality ratio sitting below a DLRM one, so the
+    # benchmarked model (when provenance names one) splits groups too.
     prov = e.get("provenance") or {}
     return (e.get("metric"), e.get("backend"), bool(e.get("proxy")),
-            e.get("batch", prov.get("batch")))
+            e.get("batch", prov.get("batch")), prov.get("model"))
 
 
 def detect_regressions(entries: List[Dict],

@@ -287,6 +287,60 @@ def _render_engine(engine: str, events: Dict[str, List[Dict[str, Any]]],
                      "per-op summaries below)_")
         lines.append("")
 
+    # -- population engine: chains / exchanges / crossovers ---------------
+    # These sections render ONLY when population events are present, so
+    # single-chain reports stay byte-identical to what they were before
+    # the population engine existed (golden-checked by the tests).
+    chain_cands = [c for c in cands if c.get("chain") is not None]
+    exchanges = of("search_exchange")
+    crossovers = of("search_crossover")
+    if chain_cands:
+        by_chain: Dict[Any, List[Dict[str, Any]]] = {}
+        for c in chain_cands:
+            by_chain.setdefault(c["chain"], []).append(c)
+        lines.append("### Per-chain convergence")
+        lines.append("")
+        lines.append("| chain | proposals | accepted | best ms |")
+        lines.append("|---|---|---|---|")
+        for ci in sorted(by_chain):
+            cs = by_chain[ci]
+            acc = [c for c in cs if c.get("accepted")]
+            best_c = min((float(c["new_ms"]) for c in acc
+                          if c.get("new_ms") is not None), default=None)
+            lines.append(f"| {ci} | {len(cs)} | {len(acc)} "
+                         f"({100.0 * len(acc) / len(cs):.0f}%) | "
+                         f"{_ms(best_c) if best_c is not None else '—'} |")
+        lines.append("")
+    if exchanges:
+        pairs: Dict[str, List[Dict[str, Any]]] = {}
+        for e in exchanges:
+            pairs.setdefault(f"{e.get('chain_a', '?')}<->"
+                             f"{e.get('chain_b', '?')}", []).append(e)
+        lines.append("### Replica exchange (by temperature pair)")
+        lines.append("")
+        lines.append("| pair | attempts | accepted |")
+        lines.append("|---|---|---|")
+        for pair in sorted(pairs):
+            es = pairs[pair]
+            acc = sum(1 for e in es if e.get("accepted"))
+            lines.append(f"| {pair} | {len(es)} | {acc} "
+                         f"({100.0 * acc / len(es):.0f}%) |")
+        lines.append("")
+    if crossovers:
+        lines.append("### Crossover lineage")
+        lines.append("")
+        lines.append("| iter | parents | child chain | patches | "
+                     "child ms | adopted |")
+        lines.append("|---|---|---|---|---|---|")
+        for e in crossovers:
+            lines.append(f"| {e.get('iter', '?')} | "
+                         f"{e.get('parent_a', '?')}+{e.get('parent_b', '?')}"
+                         f" | {e.get('chain', '?')} | "
+                         f"{e.get('patches', '?')} | "
+                         f"{_ms(e.get('child_ms'))} | "
+                         f"{'yes' if e.get('adopted') else ''} |")
+        lines.append("")
+
     # -- most-improved ops ----------------------------------------------
     gains = [o for o in opsums if float(o.get("gain_ms") or 0.0) > 0.0
              and o.get("op") != "<pipeline>"]

@@ -116,6 +116,32 @@ b = json.loads(sys.stdin.read())
 print(f\"identical={b['identical']}, {b['delta_proposals_per_s']} vs \"
       f\"{b['full_proposals_per_s']} proposals/s, ratio {b['ratio']}x\")"))"
 
+# Population-search smoke: search_bench --mode quality runs the
+# single-chain and population engines at an equal (tiny) budget on a
+# small transformer, judges both winners under one fresh reference
+# simulator, and appends a search_quality entry (value = single_ms /
+# population_ms, higher is better) that the perf-ledger report must
+# render without flagging a regression (docs/simulator.md
+# "Population search").
+POP_LEDGER="$SMOKE_DIR/pop_ledger.jsonl"
+POP_OUT=$(python -m flexflow_tpu.tools.search_bench transformer --devices 16 \
+    --batch-size 32 --budget 600 --seed 0 --mode quality \
+    --ledger "$POP_LEDGER") \
+  || { echo "population smoke: search_bench --mode quality failed"; exit 1; }
+grep -q '"metric": "search_quality"' "$POP_LEDGER" \
+  || { echo "population smoke: no search_quality ledger entry"; exit 1; }
+python -m flexflow_tpu.tools.perf_ledger report --ledger "$POP_LEDGER" \
+  | grep -q "# Perf ledger" \
+  || { echo "population smoke: ledger report failed"; exit 1; }
+python -m flexflow_tpu.tools.perf_ledger report --ledger "$POP_LEDGER" \
+  | grep -q "REGRESSION" \
+  && { echo "population smoke: report flags a regression on a fresh ledger"; exit 1; }
+echo "population smoke: OK ($(echo "$POP_OUT" | python -c "
+import json, sys
+b = json.loads(sys.stdin.read())
+print(f\"single {b['single_ms']}ms vs population {b['population_ms']}ms, \"
+      f\"ratio {b['ratio']}x\")"))"
+
 # Serving smoke: train the toy transformer, serve 8 concurrent HTTP
 # requests through the continuous-batching engine, verify every greedy
 # output bitwise against one-shot generate(), and fold the serving

@@ -1,0 +1,356 @@
+"""Population search engine tests (ISSUE 15).
+
+Four contracts pinned here:
+
+  * a seeded ``population_search`` is bitwise-reproducible (same
+    strategy map, same floats, same stats) — everything is driven by
+    seeded RNGs in a fixed order;
+  * the single-chain ``mcmc_search`` at default knobs is BITWISE
+    identical to the pre-population code: exact best_s/dp_s floats and
+    strategy fingerprints captured at the commit before this engine
+    landed.  The population engine must not perturb the single-chain
+    RNG stream, cost tiers, or proposal order;
+  * crossover children are costed via delta patches — a child with K
+    spliced ops charges exactly K proposals against the shared budget
+    (never a rebuild, never free);
+  * the learned cost tier only replaces the analytic roofline for op
+    families that beat it under out-of-fold cross-validation, and the
+    warm-start loader only trusts strategy files whose provenance
+    sidecar matches (content hash, device count, op coverage).
+"""
+
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.parallel.strategy import (load_warm_starts,
+                                            strategies_fingerprint)
+from flexflow_tpu.simulator.cost_model import (CostModel, LearnedCostTier,
+                                               _key_flops_bytes,
+                                               _parse_cost_key)
+from flexflow_tpu.simulator.machine import TPUMachineModel
+from flexflow_tpu.simulator.population import (PopulationKnobs,
+                                               parse_learned_flag,
+                                               population_search)
+from flexflow_tpu.simulator.search import mcmc_search
+from flexflow_tpu.tools.offline_search import build_model
+
+STRATEGIES = os.path.join(os.path.dirname(__file__), "..", "strategies")
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def test_knobs_from_env_defaults_and_overrides():
+    k = PopulationKnobs.from_env(env={})
+    assert (k.population, k.exchange_every, k.crossover_every) == (8, 50, 150)
+    assert k.learned is None
+    k = PopulationKnobs.from_env(env={"FF_SEARCH_POPULATION": "3",
+                                      "FF_SEARCH_LADDER": "1,0.5,0.25",
+                                      "FF_SEARCH_EXCHANGE": "0",
+                                      "FF_SEARCH_LEARNED": "0"})
+    assert k.population == 3 and k.ladder == (1.0, 0.5, 0.25)
+    assert k.exchange_every == 0 and k.learned is False
+    assert k.alphas(0.04) == (0.04, 0.02, 0.01)
+    # geometric ladder when no explicit list
+    k = PopulationKnobs.from_env(env={"FF_SEARCH_LADDER": "0.5"})
+    assert k.alphas(0.08)[:3] == (0.08, 0.04, 0.02)
+
+
+@pytest.mark.parametrize("env", [
+    {"FF_SEARCH_POPULATION": "1"},
+    {"FF_SEARCH_POPULATION": "zebra"},
+    {"FF_SEARCH_LADDER": "1.5"},              # ratio > 1
+    {"FF_SEARCH_LADDER": "1,0.5"},            # len != population
+    {"FF_SEARCH_LADDER": "0.5,-1", "FF_SEARCH_POPULATION": "2"},
+    {"FF_SEARCH_EXCHANGE": "-1"},
+    {"FF_SEARCH_LEARNED": "maybe"},
+])
+def test_knobs_bad_env_is_loud(env):
+    with pytest.raises(ValueError):
+        PopulationKnobs.from_env(env=env)
+
+
+def test_parse_learned_flag_tristate():
+    assert parse_learned_flag("") is None
+    assert parse_learned_flag("0") is False
+    assert parse_learned_flag("on") is True
+    with pytest.raises(ValueError):
+        parse_learned_flag("2")
+
+
+# ---------------------------------------------------------------------------
+# population engine
+# ---------------------------------------------------------------------------
+
+def _pop(budget=400, seed=3, **kw):
+    knobs = PopulationKnobs(**{"population": 4, "exchange_every": 10,
+                               "crossover_every": 20, "learned": False,
+                               **kw})
+    m = build_model("alexnet", 64, 16)
+    return population_search(m, budget=budget, seed=seed, verbose=False,
+                             knobs=knobs)
+
+
+def test_population_seeded_run_is_bitwise_reproducible():
+    a = _pop()
+    b = _pop()
+    assert dict(a) == dict(b)
+    assert a.best_s == b.best_s and a.dp_s == b.dp_s
+    assert a.chains == b.chains
+    assert a.stats == b.stats
+    assert strategies_fingerprint(dict(a)) == strategies_fingerprint(dict(b))
+
+
+def test_population_result_shape_and_budget():
+    r = _pop(budget=300)
+    assert r.engine == "population"
+    assert len(r.chains) == 4
+    assert {c["seed"].split(":")[0] for c in r.chains} <= \
+        {"dp", "sidecar", "random"}
+    assert r.chains[0]["seed"] == "dp"
+    # fair accounting: every costed candidate — chain proposals AND
+    # crossover patches — charges the one shared budget
+    spent = r.stats["spent"]
+    assert spent <= 300
+    assert sum(c["proposals"] for c in r.chains) \
+        + r.stats["crossover"]["patches"] == spent
+    # the returned best is the best any chain ever saw
+    assert r.best_s * 1e3 <= min(c["best_ms"] for c in r.chains) + 1e-6
+    assert r.best_s <= r.dp_s
+
+
+def test_crossover_child_costs_exactly_k_patches():
+    # crossover every round: attempts must happen, and each attempt's
+    # patch count lands in the shared budget accounting
+    r = _pop(budget=200, crossover_every=1, exchange_every=0)
+    cs = r.stats["crossover"]
+    assert cs["attempts"] >= 1
+    assert cs["patches"] >= cs["attempts"]  # every attempt splices >= 1 op
+    assert sum(c["proposals"] for c in r.chains) + cs["patches"] \
+        == r.stats["spent"] <= 200
+    # adopted lineage entries record parents, child chain and K
+    for rec in r.stats["lineage"]:
+        assert rec["patches"] >= 1 and rec["chain"] in range(4)
+
+
+def test_exchange_stats_cover_adjacent_pairs():
+    r = _pop(budget=400, exchange_every=5, crossover_every=0)
+    assert set(r.stats["exchange"]) == {"0<->1", "1<->2", "2<->3"}
+    for st in r.stats["exchange"].values():
+        assert st["attempts"] >= 1 and 0 <= st["accepts"] <= st["attempts"]
+
+
+def test_population_no_worse_than_dp_and_tracks_winner():
+    r = _pop(budget=600)
+    w = r.stats["winner_chain"]
+    assert r.chains[w]["best_ms"] == min(c["best_ms"] for c in r.chains)
+
+
+def test_full_sim_escape_hatch_matches_delta(monkeypatch):
+    monkeypatch.setenv("FF_SIM_DELTA", "0")
+    full = _pop(budget=120)
+    monkeypatch.delenv("FF_SIM_DELTA")
+    delta = _pop(budget=120)
+    assert not full.stats["delta_sim"] and delta.stats["delta_sim"]
+    # same seeded walk, same floats — the delta path's bitwise-equality
+    # contract extends through the population engine
+    assert dict(full) == dict(delta)
+    assert full.best_s == delta.best_s
+
+
+# ---------------------------------------------------------------------------
+# single-chain bitwise identity (pre-population goldens)
+# ---------------------------------------------------------------------------
+
+# Captured at the commit immediately before the population engine
+# landed: mcmc_search(build_model(name, 64, nd), budget, seed) on the
+# calibrated machine.  Any drift in these floats means the single-chain
+# RNG stream or cost tiers changed — a release-breaking regression.
+SINGLE_CHAIN_GOLDENS = [
+    ("alexnet", 16, 300, 3,
+     0.00388669815776176, 0.01863936267427486,
+     "sha256:1dd6a00fcccd3c077c5835ded51dd71c56f8eb232be75f6c9134e4c886574074"),
+    ("transformer", 64, 200, 0,
+     0.013445108752907626, 0.014559030250737392,
+     "sha256:5569e1894349173d188a2095401cf2d7f0bae14ec12c1957cb96db93193965de"),
+    ("dlrm", 64, 200, 1,
+     0.00215262461467144, 0.015924557452834633,
+     "sha256:9cfb2a7f16224253e8eb70aeaa412a3a392c2ed35beb01cf8da6f7f2832c85f0"),
+]
+
+
+@pytest.mark.parametrize("name,nd,budget,seed,best_s,dp_s,fp",
+                         SINGLE_CHAIN_GOLDENS,
+                         ids=[g[0] for g in SINGLE_CHAIN_GOLDENS])
+def test_single_chain_bitwise_identical_to_pre_population(
+        name, nd, budget, seed, best_s, dp_s, fp):
+    m = build_model(name, 64, nd)
+    r = mcmc_search(m, budget=budget, seed=seed, verbose=False)
+    assert r.best_s == best_s          # exact: bitwise, not approx
+    assert r.dp_s == dp_s
+    assert strategies_fingerprint(dict(r)) == fp
+
+
+# ---------------------------------------------------------------------------
+# learned cost tier
+# ---------------------------------------------------------------------------
+
+def _dense_corpus(fn, n=8):
+    """Synthetic Dense-family corpus: n shapes x {forward, backward},
+    with times assigned by ``fn(flops, bytes, which)``."""
+    mm = TPUMachineModel.calibrated(num_devices=8)
+    probe = LearnedCostTier(mm, corpus={})
+    corpus = {}
+    for i in range(n):
+        b, din, dout = 64 * (i + 1), 256 * (i + 1), 128 * (i + 2)
+        for which in ("forward", "backward"):
+            key = f"Dense:({b}, {dout}):(({b}, {din}),)::float32:{which}"
+            fam, sub, ins, extra, _d, w = _parse_cost_key(key)
+            fl, by = _key_flops_bytes(fam, sub, ins, extra, 4.0)
+            corpus[key] = fn(probe, fl, by, which)
+    return mm, corpus
+
+
+def test_learned_tier_falls_back_when_analytic_wins_oof():
+    # times ARE the analytic roofline -> analytic OOF error is zero, the
+    # regression cannot strictly beat it -> family rejected, predictions
+    # fall through to the roofline
+    mm, corpus = _dense_corpus(
+        lambda p, fl, by, w: p._analytic_key("Dense", fl, by, w))
+    tier = LearnedCostTier(mm, corpus=corpus)
+    fam = tier.provenance["families"]["Dense"]
+    assert fam["points"] == 16 and fam["used"] is False
+    assert fam["reason"] == "analytic roofline wins out-of-fold"
+    assert tier.provenance["used_families"] == []
+    assert tier.predict(next(iter(corpus))) is None
+
+
+def test_learned_tier_used_when_it_wins_oof():
+    # times exactly log-linear in the features (and far from the
+    # roofline) -> the fit wins out-of-fold and serves predictions
+    mm, corpus = _dense_corpus(
+        lambda p, fl, by, w: 3e-6 * (1.0 + fl) ** 0.3
+        * (2.0 if w == "backward" else 1.0))
+    tier = LearnedCostTier(mm, corpus=corpus)
+    fam = tier.provenance["families"]["Dense"]
+    assert fam["used"] is True
+    assert fam["oof_log_rmse_learned"] < fam["oof_log_rmse_analytic"]
+    assert tier.provenance["used_families"] == ["Dense"]
+    key = next(iter(corpus))
+    assert tier.predict(key) == pytest.approx(corpus[key], rel=0.05)
+    # provenance reports BOTH out-of-fold errors (acceptance criterion)
+    assert {"oof_log_rmse_learned", "oof_log_rmse_analytic",
+            "folds"} <= set(fam)
+
+
+def test_learned_tier_below_threshold_never_fits():
+    mm, corpus = _dense_corpus(lambda p, fl, by, w: 1e-5, n=4)  # 8 points
+    tier = LearnedCostTier(mm, corpus=corpus)
+    fam = tier.provenance["families"]["Dense"]
+    assert fam["used"] is False and "threshold" in fam["reason"]
+
+
+def test_cost_model_learned_tier_slots_before_analytic():
+    mm, corpus = _dense_corpus(lambda p, fl, by, w: 4.2e-5)
+    tier = LearnedCostTier(mm, corpus=corpus)
+    assert tier.provenance["used_families"] == ["Dense"]
+    cost = CostModel(mm, measure=False, compute_dtype="float32")
+    cost.attach_learned_tier(tier)
+    m = build_model("alexnet", 64, 8)
+    fc = next(op for op in m.ops if op._type == "Dense")
+    from flexflow_tpu.config import ParallelConfig
+    pc = fc.legalize_pc(ParallelConfig(dims=(8, 1)))
+    before = cost.stats["learned"]
+    cost.op_time(fc, pc, "forward")
+    assert cost.stats["learned"] == before + 1
+    # once any op is priced the memo is warm: attaching then would
+    # serve mixed tiers from one cache — refused loudly
+    with pytest.raises(AssertionError):
+        cost.attach_learned_tier(tier)
+
+
+def test_population_default_learned_tier_recorded_in_stats():
+    # engine default (knobs.learned None) turns the tier on and stamps
+    # provenance; the shipped corpus has CV-winning families today
+    m = build_model("alexnet", 64, 16)
+    r = population_search(m, budget=60, seed=0, verbose=False,
+                          knobs=PopulationKnobs(population=2,
+                                                exchange_every=0,
+                                                crossover_every=0))
+    prov = r.stats["learned"]
+    assert prov is not None and prov["tier"] == "learned"
+    assert prov["corpus_points"] >= 12
+    for fam in prov["used_families"]:
+        assert prov["families"][fam]["used"] is True
+
+
+# ---------------------------------------------------------------------------
+# warm-start loader vs the shipped sidecars
+# ---------------------------------------------------------------------------
+
+def test_warm_starts_load_shipped_alexnet_sidecar():
+    m = build_model("alexnet", 64, 16)
+    warm = load_warm_starts(m, 16, strategies_dir=STRATEGIES)
+    labels = [label for label, _ in warm]
+    assert "alexnet_16.pb" in labels
+    strategies = dict(warm)["alexnet_16.pb"]
+    op_names = {op.name for op in m.ops}
+    assert set(strategies) <= op_names and strategies
+    # and the population engine actually seeds a chain from it
+    r = population_search(m, budget=40, seed=0, verbose=False,
+                          knobs=PopulationKnobs(population=2,
+                                                exchange_every=0,
+                                                crossover_every=0,
+                                                learned=False))
+    assert r.chains[1]["seed"] == "sidecar:alexnet_16.pb"
+
+
+def test_warm_starts_skip_device_mismatch_and_foreign_models():
+    m = build_model("alexnet", 64, 8)  # sidecars are all num_devices=16
+    assert load_warm_starts(m, 8, strategies_dir=STRATEGIES) == []
+    m = build_model("transformer", 64, 16)  # no .pb covers these ops
+    assert load_warm_starts(m, 16, strategies_dir=STRATEGIES) == []
+
+
+def test_warm_starts_stale_sidecar_warns_and_skips(tmp_path):
+    src = os.path.join(STRATEGIES, "alexnet_16.pb")
+    dst = str(tmp_path / "alexnet_16.pb")
+    shutil.copy(src, dst)
+    shutil.copy(src + ".meta.json", dst + ".meta.json")
+    with open(dst + ".meta.json") as f:
+        meta = json.load(f)
+    meta["content_hash"] = "sha256:" + "0" * 64  # .pb edited after stamping
+    with open(dst + ".meta.json", "w") as f:
+        json.dump(meta, f)
+    m = build_model("alexnet", 64, 16)
+    with pytest.warns(UserWarning, match="stale"):
+        assert load_warm_starts(m, 16, strategies_dir=str(tmp_path)) == []
+
+
+def test_warm_starts_missing_sidecar_is_silently_skipped(tmp_path):
+    shutil.copy(os.path.join(STRATEGIES, "alexnet_16.pb"),
+                str(tmp_path / "alexnet_16.pb"))
+    m = build_model("alexnet", 64, 16)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning fails the test
+        assert load_warm_starts(m, 16, strategies_dir=str(tmp_path)) == []
+
+
+def test_shipped_sidecars_are_fresh():
+    # the repo's own strategies/ must never ship a stale sidecar
+    from flexflow_tpu.tools.search_report import read_sidecar
+
+    pbs = [f for f in os.listdir(STRATEGIES) if f.endswith(".pb")]
+    assert pbs
+    for f in pbs:
+        meta, status = read_sidecar(os.path.join(STRATEGIES, f))
+        assert status == "ok", (f, status)
+        assert meta["num_devices"] == 16

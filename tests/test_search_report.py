@@ -263,13 +263,53 @@ def _normalize(report):
 def test_golden_output(tmp_path):
     """Byte-exact golden (modulo the masked throughput number):
     regenerate with ``python tests/test_search_report.py --regen`` after
-    deliberate format changes."""
+    deliberate format changes.  Also the population-engine guard: a
+    single-chain trace has no chain/exchange/crossover events, so the
+    population sections must not render (the golden would catch them)."""
     trace = str(tmp_path / "search.jsonl")
     _seeded_search_trace(trace)
     report = search_report.render_search_report(
         search_report.parse_trace(trace))
     with open(GOLDEN) as f:
         assert _normalize(report) == f.read()
+
+
+def test_population_trace_renders_population_sections(tmp_path):
+    """A population run's trace gains the per-chain / exchange /
+    crossover sections; they render from the candidate ``chain`` tags
+    and the search_exchange / search_crossover events."""
+    from flexflow_tpu.simulator.population import (PopulationKnobs,
+                                                   population_search)
+    from flexflow_tpu.tools.offline_search import build_model
+
+    trace = str(tmp_path / "pop.jsonl")
+    os.environ["FF_TELEMETRY"] = "1"
+    os.environ["FF_TELEMETRY_FILE"] = trace
+    events.reset_active()
+    try:
+        m = build_model("alexnet", batch_size=64, num_devices=16)
+        knobs = PopulationKnobs(population=4, exchange_every=5,
+                                crossover_every=10, learned=False)
+        population_search(m, budget=300, seed=3, verbose=False,
+                          knobs=knobs)
+    finally:
+        events.reset_active()
+        del os.environ["FF_TELEMETRY"]
+        del os.environ["FF_TELEMETRY_FILE"]
+    report = search_report.render_search_report(
+        search_report.parse_trace(trace))
+    assert "## Search: population" in report
+    assert "### Per-chain convergence" in report
+    assert "### Replica exchange (by temperature pair)" in report
+    # every chain shows a row
+    for ci in range(4):
+        assert re.search(rf"^\| {ci} \| \d+ \| \d+", report, re.M)
+    # crossover attempts (if any spliced) render a lineage table; the
+    # section is event-gated, so only assert when events exist
+    recs = search_report.parse_trace(trace)
+    if any(r.get("name") == "search_crossover" for r in recs
+           if r.get("t") == "event"):
+        assert "### Crossover lineage" in report
 
 
 # ---------------------------------------------------------------------------
