@@ -138,6 +138,11 @@ class FFModel:
         # In-training per-op attribution (observability/opprof.py):
         # non-None only when FF_OPPROF rides an enabled telemetry log.
         self._opprof = None
+        # Memory & compile plane (observability/memplane.py): non-None
+        # only when FF_MEMPLANE rides an enabled telemetry log — wraps
+        # the jitted steps with an explicit compile cache that emits
+        # compile_done / xla_memory / xla_cost and counts retraces.
+        self._memplane = None
         # Fault injector (testing/chaos.py, FF_CHAOS) and non-finite
         # step guard (runtime/resilience.py, FF_SKIP_NONFINITE) — both
         # resolved once at compile(), None when their env knob is unset
@@ -811,6 +816,7 @@ class FFModel:
             self._stepstats = None
             self._health = None
             self._opprof = None
+            self._memplane = None
             return self._compile_impl(optimizer, loss_type, metrics, machine)
         with self._telemetry.span("compile", num_ops=len(self.ops)) as at:
             self._compile_impl(optimizer, loss_type, metrics, machine)
@@ -832,8 +838,13 @@ class FFModel:
         _ff_metrics.maybe_start(self._telemetry)
         self._opprof = _ff_opprof.maybe_profiler(self, self._telemetry)
         from .observability import agreement as _ff_agreement
+        from .observability import memplane as _ff_memplane
 
         _ff_agreement.emit_compile_prediction(self, self._telemetry)
+        # Memory plane: the predicted view (one event, every telemetry
+        # run) + the FF_MEMPLANE-gated compile observatory.
+        self._memplane = _ff_memplane.maybe_plane(self._telemetry)
+        _ff_memplane.emit_memory_prediction(self, self._telemetry)
         self._telemetry.flush()
 
     def _compile_impl(self, optimizer=None,
@@ -1093,9 +1104,13 @@ class FFModel:
 
         if tel is not None:
             from .observability import agreement as _ff_agreement
+            from .observability import memplane as _ff_memplane
 
             # post-swap divergence must compare against the NEW strategy
             _ff_agreement.emit_compile_prediction(self, tel)
+            # ... and so must the predicted-HBM view (the swapped plan
+            # may trade step time for residency)
+            _ff_memplane.emit_memory_prediction(self, tel)
             tel.flush()
 
     def _export_provenance(self) -> Optional[Dict[str, Any]]:
@@ -2019,8 +2034,11 @@ class FFModel:
                                       new_stats, new_opt, mvec, macc)
             return new_params, new_stats, new_opt, macc + mvec
 
-        return jax.jit(step if accum == 1 else step_accum,
-                       donate_argnums=(0, 1, 2, 6))
+        fn = jax.jit(step if accum == 1 else step_accum,
+                     donate_argnums=(0, 1, 2, 6))
+        if self._memplane is not None:
+            fn = self._memplane.wrap("train_step", fn)
+        return fn
 
     def _build_eval_step(self):
         loss_t = self._loss_input_tensor()
@@ -2036,7 +2054,10 @@ class FFModel:
             msum["loss"] = loss
             return msum, env[probs_t.guid]
 
-        return jax.jit(estep)
+        fn = jax.jit(estep)
+        if self._memplane is not None:
+            fn = self._memplane.wrap("eval_step", fn)
+        return fn
 
     # ------------------------------------------------------------------
     # driver API (reference: forward/zero_gradients/backward/update —
@@ -2532,6 +2553,8 @@ class FFModel:
                     carry0, (feed, use))
                 return outs                                   # (P+N-1, B)
 
+            if self._memplane is not None:
+                run = self._memplane.wrap(f"generate:{B}x{P}x{N}", run)
             cache[ckey] = run
 
         feed = jnp.concatenate(
@@ -2653,6 +2676,9 @@ class FFModel:
                     carry0, (feed, use, do_exp))
                 return buf.reshape(B, K, N), scores
 
+            if self._memplane is not None:
+                run = self._memplane.wrap(
+                    f"beam_search:{B}x{P}x{N}x{K}", run)
             cache[ckey] = run
 
         feed = jnp.concatenate(

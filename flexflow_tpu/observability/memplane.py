@@ -1,0 +1,222 @@
+"""Memory & compile plane: XLA executable introspection + retrace
+tracking.
+
+Two jobs, both riding an enabled telemetry log:
+
+* **Predicted** (always on with telemetry): ``emit_memory_prediction``
+  runs the analytic per-device memory model (``simulator/memory.py``)
+  over the model's RESOLVED strategies at compile/recompile and emits
+  one ``memory_predicted`` event — peak device, per-term breakdown,
+  headroom against the calibrated machine's ``hbm_capacity``.
+
+* **Compiled** (``FF_MEMPLANE=1``): ``MemPlane.wrap`` replaces a
+  ``jax.jit`` callable's implicit compile cache with an explicit
+  signature-keyed one built on the AOT path
+  (``fn.lower(*args).compile()``), so every compile is OWNED: its wall
+  is timed, ``compiled.memory_analysis()`` / ``cost_analysis()`` are
+  harvested into ``xla_memory`` / ``xla_cost`` events with
+  per-executable (``site``) attribution, and a recompile at a site that
+  already compiled — a RETRACE, the serving bucket ladder's silent
+  failure mode — increments the cumulative ``compile_retraces`` counter
+  the ``/metrics`` exporter renders as ``ff_compile_retraces_total``.
+  A known signature dispatches straight to the cached executable: the
+  steady-state overhead is one dict lookup plus a leaf-shape key build.
+
+  Distinct SITES are distinct executables (train step, eval step, each
+  serving bucket, each generate shape class) — a new site compiling is
+  expected and counted only in ``compiles``; only a same-site new
+  signature is a retrace.
+
+  If the AOT path is unavailable (exotic backend / staged-out
+  transform), the wrapper falls back to calling the original jitted
+  function — compile events still fire (the first call's wall includes
+  the compile) with ``aot=false`` and no XLA analysis, and training is
+  never broken by observability.
+
+Disabled is free: ``maybe_plane`` returns None unless ``FF_MEMPLANE``
+is set AND a telemetry log exists, and every call site guards on the
+established None-handle pattern.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Dict, Optional
+
+# Events carry at most this many per-op rows — a 1000-op graph must not
+# turn one trace line into a megabyte.
+MAX_OP_ROWS = 32
+
+
+def enabled_from_env() -> bool:
+    """``FF_MEMPLANE`` truthy (any non-empty value but "0")."""
+    return os.environ.get("FF_MEMPLANE", "") not in ("", "0")
+
+
+def maybe_plane(log) -> Optional["MemPlane"]:
+    """Resolve the compile plane at ``compile()``: None unless
+    ``FF_MEMPLANE`` is set AND telemetry is on (the events are the whole
+    product — without a log there is nothing to attribute into)."""
+    if log is None or not enabled_from_env():
+        return None
+    return MemPlane(log)
+
+
+def _sig_key(args: tuple) -> tuple:
+    """Signature key matching jit's retrace triggers for our call sites:
+    pytree structure + per-leaf (shape, dtype) for arrays, type for
+    python scalars (jit keys weak-typed scalars by type, not value)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            sig.append((tuple(shape), str(getattr(leaf, "dtype", ""))))
+        else:
+            sig.append((type(leaf).__name__,))
+    return (str(treedef), tuple(sig))
+
+
+def _fingerprint(site: str, key: tuple) -> str:
+    return hashlib.sha1(repr((site, key)).encode()).hexdigest()[:12]
+
+
+class MemPlane:
+    """Per-model (or per-engine) compile observatory.  One instance per
+    telemetry log consumer; all wrapped callables share its cumulative
+    ``compiles`` / ``retraces`` counters."""
+
+    def __init__(self, log):
+        self.log = log
+        self.compiles = 0
+        self.retraces = 0
+
+    def wrap(self, site: str, fn) -> "_WrappedJit":
+        return _WrappedJit(self, site, fn)
+
+    # -- event emission -------------------------------------------------
+    def on_compile(self, site: str, key: tuple, wall_s: float,
+                   retrace: bool, compiled, aot: bool) -> None:
+        self.compiles += 1
+        if retrace:
+            self.retraces += 1
+        fp = _fingerprint(site, key)
+        log = self.log
+        log.event("compile_done", site=site, fingerprint=fp,
+                  wall_s=round(wall_s, 4), retrace=bool(retrace),
+                  aot=bool(aot), total_compiles=self.compiles,
+                  total_retraces=self.retraces)
+        log.counter("compiles", 1, site=site)
+        # 0-increments keep the series alive (and scrapeable) from the
+        # first compile, so "flat" is observable, not just absent
+        log.counter("compile_retraces", 1 if retrace else 0, site=site)
+        if compiled is not None:
+            try:
+                m = compiled.memory_analysis()
+                attrs = {}
+                for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                          "temp_size_in_bytes",
+                          "generated_code_size_in_bytes",
+                          "alias_size_in_bytes"):
+                    v = getattr(m, k, None)
+                    if v is not None:
+                        attrs[k.replace("_size_in_bytes", "_bytes")] = int(v)
+                total = (attrs.get("argument_bytes", 0)
+                         + attrs.get("output_bytes", 0)
+                         + attrs.get("temp_bytes", 0)
+                         - attrs.get("alias_bytes", 0))
+                log.event("xla_memory", site=site, fingerprint=fp,
+                          total_bytes=int(total), **attrs)
+            except Exception as e:  # noqa: BLE001 — introspection is advisory
+                log.event("xla_memory_error", site=site, error=repr(e))
+            try:
+                c = compiled.cost_analysis()
+                if isinstance(c, (list, tuple)):  # older jaxlib returns [dict]
+                    c = c[0] if c else {}
+                log.event("xla_cost", site=site, fingerprint=fp,
+                          flops=float(c.get("flops", 0.0)),
+                          bytes_accessed=float(c.get("bytes accessed", 0.0)))
+            except Exception as e:  # noqa: BLE001
+                log.event("xla_cost_error", site=site, error=repr(e))
+        log.flush()
+
+
+class _WrappedJit:
+    """Signature-keyed explicit compile cache around one jitted
+    callable.  Positional args only — every wrapped call site in
+    model.py / serving/engine.py calls positionally."""
+
+    __slots__ = ("plane", "site", "fn", "_compiled")
+
+    def __init__(self, plane: MemPlane, site: str, fn):
+        self.plane = plane
+        self.site = site
+        self.fn = fn
+        self._compiled: Dict[tuple, Any] = {}
+
+    def __call__(self, *args):
+        key = _sig_key(args)
+        call = self._compiled.get(key)
+        if call is not None:
+            return call(*args)
+        retrace = bool(self._compiled)
+        t0 = time.perf_counter()
+        try:
+            compiled = self.fn.lower(*args).compile()
+        except Exception:  # noqa: BLE001 — AOT unavailable: jit fallback
+            out = self.fn(*args)  # first call pays trace+compile here
+            self._compiled[key] = self.fn
+            self.plane.on_compile(self.site, key,
+                                  time.perf_counter() - t0, retrace,
+                                  None, aot=False)
+            return out
+        wall = time.perf_counter() - t0
+        self._compiled[key] = compiled
+        self.plane.on_compile(self.site, key, wall, retrace, compiled,
+                              aot=True)
+        return compiled(*args)
+
+
+# ---------------------------------------------------------------------------
+# predicted-view emission (independent of FF_MEMPLANE: one cheap event
+# per compile, the anchor every other view diffs against)
+# ---------------------------------------------------------------------------
+
+def emit_memory_prediction(model, log) -> None:
+    """Run the analytic memory model over the model's resolved
+    strategies and fold one ``memory_predicted`` event into ``log``.
+    Advisory: a memory-model failure must never break compile."""
+    if log is None:
+        return
+    try:
+        from ..simulator.machine import TPUMachineModel
+        from ..simulator.memory import memory_per_device
+
+        nd = model.machine.num_devices if model.machine is not None \
+            else model.config.num_devices
+        mm = TPUMachineModel.calibrated(num_devices=nd)
+        mem = memory_per_device(model, None, machine_model=mm)
+        peak = mem["per_device"][mem["peak_device"]]
+        ops = sorted(mem["by_op"].items(), key=lambda kv: -kv[1]["bytes"])
+        by_op = {name: row["bytes"] for name, row in ops[:MAX_OP_ROWS]}
+        if len(ops) > MAX_OP_ROWS:
+            by_op["<other>"] = sum(row["bytes"]
+                                   for _, row in ops[MAX_OP_ROWS:])
+        log.event("memory_predicted",
+                  num_devices=mem["num_devices"],
+                  peak_bytes=mem["peak_bytes"],
+                  peak_device=mem["peak_device"],
+                  dominant_term=mem["dominant_term"],
+                  terms={k: peak[k] for k in
+                         ("params", "grads", "optimizer", "activations",
+                          "staging")},
+                  capacity_bytes=mem.get("capacity_bytes"),
+                  headroom_bytes=mem.get("headroom_bytes"),
+                  opt_slots=mem["opt_slots"],
+                  by_op=by_op)
+    except Exception as e:  # noqa: BLE001 — prediction is advisory
+        log.event("memory_predicted_error", error=repr(e))

@@ -53,7 +53,7 @@ from . import events
 
 # attr keys that may become Prometheus labels; everything else is
 # dropped from the label set (NOT from the trace) to bound cardinality
-LABEL_KEYS = ("event", "kind", "op", "outcome", "phase", "reason",
+LABEL_KEYS = ("device", "event", "kind", "op", "outcome", "phase", "reason",
               "replica", "scope", "site", "src", "status", "which",
               "window")
 

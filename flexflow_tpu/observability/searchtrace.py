@@ -319,6 +319,23 @@ def build_provenance(model, strategies, engine: str, budget: int,
                                          machine_model=machine_model)
     except Exception as e:  # attribution is advisory; never block export
         meta["ops_error"] = repr(e)
+    try:
+        # Predicted per-device HBM under this strategy map — the search
+        # platform's multi-objective input (ROADMAP item 3) and what
+        # tools/memory_report.py diffs against XLA's memory_analysis.
+        from ..simulator.machine import TPUMachineModel
+        from ..simulator.memory import memory_per_device
+
+        mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
+        mem = memory_per_device(model, strategies, machine_model=mm)
+        meta["hbm_per_device_bytes"] = [row["total"]
+                                        for row in mem["per_device"]]
+        meta["hbm_peak_bytes"] = mem["peak_bytes"]
+        meta["hbm_dominant_term"] = mem["dominant_term"]
+        if "capacity_bytes" in mem:
+            meta["hbm_capacity_bytes"] = mem["capacity_bytes"]
+    except Exception as e:  # advisory; never block export
+        meta["hbm_error"] = repr(e)
     if extra:
         meta.update(extra)
     return meta

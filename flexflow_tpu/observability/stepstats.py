@@ -72,21 +72,40 @@ def estimate_collective_bytes(model) -> int:
     return int(total)
 
 
-def device_memory_stats() -> Optional[dict]:
-    """{"bytes_in_use", "peak_bytes_in_use"} when the backend exposes
-    allocator stats (TPU/GPU), else None (CPU)."""
+# allocator-stat keys sampled per device, with the short ``kind`` label
+# they export under on /metrics (``ff_hbm_bytes{device,kind}``)
+MEM_STAT_KINDS = (("bytes_in_use", "in_use"),
+                  ("peak_bytes_in_use", "peak"),
+                  ("bytes_limit", "limit"))
+
+
+def device_memory_stats() -> Optional[list]:
+    """Per-device allocator stats across ALL local devices: a list of
+    ``{"device": i, "bytes_in_use": ..., "peak_bytes_in_use": ...,
+    "bytes_limit": ...}`` rows (keys present when the backend reports
+    them).  Devices whose ``memory_stats()`` returns None or raises
+    mid-list are skipped — some backends report stats for a subset.
+    None when NO device reports (CPU)."""
     try:
         import jax
 
-        ms = jax.local_devices()[0].memory_stats()
+        devs = jax.local_devices()
     except Exception:
         return None
-    if not ms:
-        return None
-    out = {}
-    for k in ("bytes_in_use", "peak_bytes_in_use"):
-        if k in ms:
-            out[k] = int(ms[k])
+    out = []
+    for i, d in enumerate(devs):
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        rec = {"device": i}
+        for k, _ in MEM_STAT_KINDS:
+            if k in ms:
+                rec[k] = int(ms[k])
+        if len(rec) > 1:
+            out.append(rec)
     return out or None
 
 
@@ -154,10 +173,19 @@ class StepStats:
             log.gauge("est_collective_bytes_per_step",
                       float(self._collective_bytes))
         if first or self.steps % MEM_GAUGE_EVERY == 0:
-            mem = device_memory_stats()
-            if mem:
-                for k, v in mem.items():
-                    log.gauge(f"device_{k}", float(v))
+            mems = device_memory_stats()
+            if mems:
+                for rec in mems:
+                    dev = str(rec["device"])
+                    for k, kind in MEM_STAT_KINDS:
+                        if k in rec:
+                            log.gauge("hbm_bytes", float(rec[k]),
+                                      device=dev, kind=kind)
+                # legacy single-device series (trace_report's summary
+                # line and older dashboards key on these)
+                for k in ("bytes_in_use", "peak_bytes_in_use"):
+                    if k in mems[0]:
+                        log.gauge(f"device_{k}", float(mems[0][k]))
         log.flush()
         health = getattr(self.model, "_health", None)
         if health is not None:

@@ -137,6 +137,12 @@ class InferenceEngine:
 
         self._telemetry = telemetry if telemetry is not None \
             else getattr(model, "_telemetry", None)
+        # Compile plane (FF_MEMPLANE): wraps every bucket-ladder jit so
+        # a silent retrace — THE serving failure mode — shows up as a
+        # compile_done{retrace} event and on ff_compile_retraces_total.
+        from ..observability import memplane as _memplane
+
+        self._memplane = _memplane.maybe_plane(self._telemetry)
         self._chaos = getattr(model, "_chaos", None)
 
         B = self.config.max_batch
@@ -205,8 +211,10 @@ class InferenceEngine:
                     params, stats, caches, toks, pos, tok_t, pos_t)
                 return caches, jnp.argmax(probs, axis=-1).astype(jnp.int32)
 
-            self._step_fn = jax.jit(
-                step, donate_argnums=(2,) if self._donate else ())
+            fn = jax.jit(step, donate_argnums=(2,) if self._donate else ())
+            if self._memplane is not None:
+                fn = self._memplane.wrap("serve_step", fn)
+            self._step_fn = fn
         return self._step_fn
 
     def _get_prefill_fn(self, bucket: int):
@@ -227,7 +235,10 @@ class InferenceEngine:
                                             jnp.arange(bucket))
                 return caches, outs[:, 0]  # next-token after each prefix
 
-            fn = self._prefill_fns[bucket] = jax.jit(prefill)
+            fn = jax.jit(prefill)
+            if self._memplane is not None:
+                fn = self._memplane.wrap(f"serve_prefill:{bucket}", fn)
+            self._prefill_fns[bucket] = fn
             self._stats["prefill_compiles"] += 1
         return fn
 
@@ -245,8 +256,11 @@ class InferenceEngine:
                         (slot,) + (jnp.int32(0),) * (g.ndim - 1)),
                     pool, piece)
 
-            self._insert_fn = jax.jit(
-                insert, donate_argnums=(0,) if self._donate else ())
+            fn = jax.jit(insert,
+                         donate_argnums=(0,) if self._donate else ())
+            if self._memplane is not None:
+                fn = self._memplane.wrap("serve_insert", fn)
+            self._insert_fn = fn
         return self._insert_fn
 
     # ------------------------------------------------------------------
@@ -276,8 +290,10 @@ class InferenceEngine:
                     block_tables=tables)
                 return caches, jnp.argmax(probs, axis=-1).astype(jnp.int32)
 
-            fn = self._paged_step_fns[w] = jax.jit(
-                step, donate_argnums=(2,) if self._donate else ())
+            fn = jax.jit(step, donate_argnums=(2,) if self._donate else ())
+            if self._memplane is not None:
+                fn = self._memplane.wrap(f"serve_paged_step:w{w}", fn)
+            self._paged_step_fns[w] = fn
         return fn
 
     def _get_paged_prefill_fn(self, n_gb: int, sbucket: int):
@@ -329,8 +345,12 @@ class InferenceEngine:
                 pool = jax.tree.map(scatter, pool, dense)
                 return pool, outs[:, 0]
 
-            fn = self._paged_prefill_fns[key] = jax.jit(
-                prefill, donate_argnums=(2,) if self._donate else ())
+            fn = jax.jit(prefill,
+                         donate_argnums=(2,) if self._donate else ())
+            if self._memplane is not None:
+                fn = self._memplane.wrap(
+                    f"serve_paged_prefill:g{n_gb}s{sbucket}", fn)
+            self._paged_prefill_fns[key] = fn
             self._stats["prefill_compiles"] += 1
         return fn
 
@@ -750,6 +770,16 @@ class InferenceEngine:
                 st = self._kvpool.stats()
                 self._telemetry.gauge("serve_kv_blocks_used",
                                       st["blocks_used"], replica=self.name)
+                # KV residency folded into the live-HBM series: block
+                # accounting is host-side truth for device bytes the
+                # allocator gauges can't attribute
+                if self._kvpool.bytes_per_block:
+                    self._telemetry.gauge(
+                        "hbm_bytes",
+                        float(st["blocks_used"]
+                              * self._kvpool.bytes_per_block),
+                        device="pool", kind="kv_blocks",
+                        replica=self.name)
                 self._telemetry.counter("serve_decode_window", 1,
                                         window=w * self.config.kv_block)
         for i, slot in enumerate(self._slots):

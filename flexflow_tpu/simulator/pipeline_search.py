@@ -47,6 +47,7 @@ import numpy as np
 from ..config import ParallelConfig
 from .cost_model import CostModel
 from .machine import TPUMachineModel
+from .memory import HBM_SAFETY, dominant_term, weight_state_terms
 
 
 def _intended_host_placed(model, op) -> bool:
@@ -123,7 +124,8 @@ def _stage_prep(model, S: int):
 def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
                        S: int, dp: int, microbatches: int,
                        remat: Optional[bool] = None,
-                       prep=None) -> Optional[dict]:
+                       prep=None, reject_out: Optional[dict] = None
+                       ) -> Optional[dict]:
     """{"t": simulated seconds/iteration, "m": the ADJUSTED microbatch
     count the plan actually uses, "mem": estimated per-device bytes,
     "remat": schedule} for a dp×S GPipe plan, or None when the plan is
@@ -133,7 +135,11 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
     ``remat=None`` both schedules are derived from ONE costing pass
     (remat only changes two arithmetic terms) and the cheaper in-budget
     one is returned.  ``prep``: a ``_stage_prep(model, S)`` result to
-    reuse across an M sweep."""
+    reuse across an M sweep.  ``reject_out``: a dict the HBM gate fills
+    when it rejects a schedule — ``reason`` names the dominant memory
+    term (e.g. ``"hbm:activations"``) plus the offending byte counts —
+    so the search trace can say WHY a plan died instead of silently
+    skipping it."""
     batch = model.ops[0].output.dims[0]
     if batch % dp != 0:
         return None
@@ -216,15 +222,24 @@ def cost_pipeline_plan(model, machine: TPUMachineModel, cost: CostModel,
         t_pipe = max(ticks * (t_f + t_b + 2.0 * t_comm
                               + (t_f if rm else 0.0)) + t_sync,
                      t_head)
-        # HBM budget: weights (f32 master + grad + optimizer slot) plus
-        # scan residuals alive at the fwd->bwd turnaround — every
-        # tick's stash (interiors drop out under remat)
+        # HBM budget: weight state (f32 master + grad + optimizer slot,
+        # the shared simulator/memory.py terms) plus scan residuals
+        # alive at the fwd->bwd turnaround — every tick's stash
+        # (interiors drop out under remat)
         if rm:
             act = ticks * carry_bytes + max(slot_act) * cost._dtype_bytes
         else:
             act = ticks * (max(slot_act) * cost._dtype_bytes + carry_bytes)
-        mem = 3.0 * 4.0 * w_elems + act
-        if mem > 0.9 * machine.hbm_capacity:
+        terms = weight_state_terms(w_elems, opt_slots=1)
+        terms["activations"] = act
+        mem = sum(terms.values())
+        if mem > HBM_SAFETY * machine.hbm_capacity:
+            if reject_out is not None:
+                reject_out.update(
+                    reason=f"hbm:{dominant_term(terms)}",
+                    mem_bytes=int(mem),
+                    budget_bytes=int(HBM_SAFETY * machine.hbm_capacity),
+                    terms={k: int(v) for k, v in terms.items()})
             continue
         if best is None or t_pipe < best["t"]:
             best = {"t": t_pipe, "m": M, "mem": mem, "remat": rm}
@@ -272,8 +287,18 @@ def search_pipeline(model, machine_model: Optional[TPUMachineModel] = None,
             if prep is None:
                 continue
             for M in Ms:
-                r = cost_pipeline_plan(model, mm, cost, S, dp, M, prep=prep)
+                reject: dict = {}
+                r = cost_pipeline_plan(model, mm, cost, S, dp, M,
+                                       prep=prep, reject_out=reject)
                 if r is None:
+                    if reject and rec is not None:
+                        # over-HBM plans are recorded, not silently
+                        # skipped — the reason names the dominant term
+                        rec.plan(f"S{S}xdp{dp},M{M}", cost_ms=0.0,
+                                 accepted=False, stages=S, dp=dp, m=M,
+                                 reason=reject["reason"],
+                                 mem_bytes=reject["mem_bytes"],
+                                 budget_bytes=reject["budget_bytes"])
                     continue
                 plans += 1
                 improved = best is None or r["t"] < best["simulated_s"]

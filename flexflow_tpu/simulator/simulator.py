@@ -77,6 +77,19 @@ class Simulator:
             ids = list(range(n))
         return [d % self.machine.num_devices for d in ids]
 
+    def memory_per_device(self, model,
+                          strategies: Optional[Dict[str, ParallelConfig]]
+                          = None) -> Dict:
+        """Predicted per-device HBM under ``strategies`` (same fallback
+        resolution as ``simulate_runtime``) — params + grads + optimizer
+        slots + live activations + collective staging, priced by
+        ``simulator/memory.py`` against this simulator's machine model
+        (its ``hbm_capacity`` supplies the headroom)."""
+        from .memory import memory_per_device
+
+        return memory_per_device(model, strategies,
+                                 machine_model=self.machine)
+
     def simulate_runtime(self, model, strategies: Dict[str, ParallelConfig]) -> float:
         """Simulated seconds per training iteration under ``strategies``
         (keyed by op name; missing ops fall back to their compiled pc or

@@ -148,6 +148,69 @@ def _metrics():
     return ", ".join(bits)
 
 
+def _memory():
+    # The memory & compile plane at a glance: effective FF_MEMPLANE
+    # state, whether this backend reports allocator stats at all (TPU:
+    # yes; CPU: no — live hbm_bytes gauges will be absent), and an
+    # analytic headroom check of the default transformer against the
+    # calibrated machine model.  WARN when the serving KV-block budget
+    # plus the model's weight state cannot fit HBM — that misconfig
+    # otherwise surfaces as an OOM at the first full-load prefill.
+    from ..observability import events, memplane
+    from ..observability.stepstats import device_memory_stats
+
+    mp = os.environ.get("FF_MEMPLANE", "")
+    bits = [f"FF_MEMPLANE={'on' if memplane.enabled_from_env() else mp or 'off'}"]
+    if memplane.enabled_from_env() and not events._env_enabled():
+        bits.append("WARN: FF_MEMPLANE set but FF_TELEMETRY off — "
+                    "compile/memory events have no log to land in (inert)")
+    mems = device_memory_stats()
+    if mems:
+        bits.append(f"allocator stats: {len(mems)} device(s) report")
+    else:
+        bits.append("allocator stats: unavailable "
+                    "(CPU backend reports none)")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import flexflow_tpu as ff
+    from ..models.transformer import build_transformer
+    from ..serving.config import ServeConfig
+    from ..simulator.machine import TPUMachineModel
+    from ..simulator.memory import memory_per_device
+
+    # graph build only — memory_per_device needs no compile
+    m = ff.FFModel(ff.FFConfig(batch_size=8))
+    layers, embed = 4, 512
+    build_transformer(m, 8, seq_length=128, num_layers=layers,
+                      embed_dim=embed, num_heads=8)
+    mm = TPUMachineModel.calibrated(num_devices=8)
+    mem = memory_per_device(m, machine_model=mm)
+    peak, cap = mem["peak_bytes"], mem["capacity_bytes"]
+    bits.append(f"predicted peak (default transformer, 8 devices): "
+                f"{peak / 2**20:.0f} MiB of {cap / 2**30:.0f} GiB HBM "
+                f"({100.0 * (cap - peak) / cap:.1f}% headroom, "
+                f"dominant {mem['dominant_term']})")
+
+    scfg = ServeConfig.from_env()
+    # per-position KV state of the headroom model: K+V, all layers
+    kv_bytes_per_block = scfg.kv_block * 2 * embed * layers * 4
+    kv_budget = scfg.kv_blocks_resolved() * kv_bytes_per_block
+    if kv_budget + peak > cap:
+        bits.append(f"WARN: serving KV budget "
+                    f"({scfg.kv_blocks_resolved()} blocks ~ "
+                    f"{kv_budget / 2**30:.1f} GiB) + model state "
+                    f"({peak / 2**30:.1f} GiB) exceeds HBM capacity "
+                    f"({cap / 2**30:.0f} GiB) — expect serving OOM at "
+                    f"full load")
+    else:
+        bits.append(f"serving KV budget fits: "
+                    f"{scfg.kv_blocks_resolved()} blocks ~ "
+                    f"{kv_budget / 2**20:.0f} MiB on top of model state")
+    return ", ".join(bits)
+
+
 def _resilience():
     # Effective chaos/recovery env as chaos.py/resilience.py will see
     # it.  An invalid FF_CHAOS spec fails HERE (required-style error in
@@ -407,6 +470,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              ("optional deps", _optional_deps, False),
              ("observability", _observability, False),
              ("metrics", _metrics, False),
+             ("memory", _memory, False),
              ("perf", lambda: _perf(probe=not args.skip_accelerator), False),
              ("search", _search, False),
              ("resilience", _resilience, False),

@@ -21,12 +21,14 @@ else
 fi
 
 # Telemetry smoke: a 2-step tiny training run under FF_TELEMETRY +
-# FF_HEALTH must produce a readable trace, a heartbeat file, and both
-# reports must fold it (docs/observability.md).
+# FF_HEALTH + FF_MEMPLANE must produce a readable trace (including the
+# compile plane's owned-compile and XLA introspection events), a
+# heartbeat file, and all three reports must fold it
+# (docs/observability.md).
 SMOKE_DIR=$(mktemp -d)
 TRACE="$SMOKE_DIR/smoke.jsonl"
 HEARTBEAT="$SMOKE_DIR/hb.json"
-FF_TELEMETRY=1 FF_TELEMETRY_FILE="$TRACE" \
+FF_TELEMETRY=1 FF_TELEMETRY_FILE="$TRACE" FF_MEMPLANE=1 \
   FF_HEALTH=1 FF_HEARTBEAT_PATH="$HEARTBEAT" \
   python examples/alexnet.py -b 8 --iterations 2 -e 1 > /dev/null
 REPORT=$(python -m flexflow_tpu.tools.trace_report "$TRACE")
@@ -36,7 +38,15 @@ python -m flexflow_tpu.tools.health_report "$TRACE" > /dev/null \
   || { echo "health smoke: health_report failed"; exit 1; }
 grep -q '"phase"' "$HEARTBEAT" \
   || { echo "health smoke: heartbeat file missing/empty"; exit 1; }
-echo "telemetry+health smoke: OK ($(wc -l < "$TRACE") trace records)"
+grep -q '"name": "compile_done"' "$TRACE" \
+  || { echo "memory smoke: no compile_done event in trace"; exit 1; }
+grep -q '"name": "xla_memory"' "$TRACE" \
+  || { echo "memory smoke: no xla_memory event in trace"; exit 1; }
+MEMREPORT=$(python -m flexflow_tpu.tools.memory_report "$TRACE") \
+  || { echo "memory smoke: memory_report failed"; exit 1; }
+echo "$MEMREPORT" | grep -q "headroom: \*\*" \
+  || { echo "memory smoke: report missing headroom line"; exit 1; }
+echo "telemetry+health+memory smoke: OK ($(wc -l < "$TRACE") trace records)"
 
 # Degradation-ladder smoke: with no chip attached, bench.py must DEGRADE
 # (CPU proxy metric stamped proxy:true, rc=0, a parseable perf-ledger
@@ -203,7 +213,7 @@ print(f\"hit rate {b['prefix_hit_rate']}, \"
 # text (docs/observability.md "Live metrics endpoint").
 METRICS_PORT=9109
 METRICS_TRACE="$SMOKE_DIR/metrics_serve.jsonl"
-FF_TELEMETRY=1 FF_TELEMETRY_FILE="$METRICS_TRACE" \
+FF_TELEMETRY=1 FF_TELEMETRY_FILE="$METRICS_TRACE" FF_MEMPLANE=1 \
   FF_METRICS_PORT=$METRICS_PORT FF_METRICS_HOST=127.0.0.1 \
   python -m flexflow_tpu.tools.loadgen --requests 24 --concurrency 4 \
     --replicas 2 --seed 0 --train-iters 20 \
@@ -214,7 +224,10 @@ python - "$METRICS_PORT" <<'EOF' \
 import re, sys, time, urllib.request
 url = f"http://127.0.0.1:{sys.argv[1]}/metrics"
 want = ("ff_replica_up", "ff_samples_total",   # serving + training series
-        "ff_serve_kv_blocks_used", "ff_serve_kv_blocks_free")  # paged KV
+        "ff_serve_kv_blocks_used", "ff_serve_kv_blocks_free",  # paged KV
+        "ff_hbm_bytes",                # KV-pool block bytes (CPU has no
+                                       # allocator stats; pool gauge only)
+        "ff_compile_retraces_total")   # compile plane: flat-ladder ledger
 sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eE]+$')
 deadline = time.time() + 180
 while time.time() < deadline:

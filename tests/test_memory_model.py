@@ -1,0 +1,254 @@
+"""Analytic per-device HBM model (simulator/memory.py) — the PREDICTED
+view of the memory observatory — cross-checked against XLA's own
+``compiled.memory_analysis()`` on the CPU backend, plus the pipeline
+search's dominant-term rejection reasons and the provenance sidecar's
+``hbm_per_device_bytes`` stamp."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+import flexflow_tpu as ff
+from flexflow_tpu.observability import events
+from flexflow_tpu.simulator.machine import TPUMachineModel
+from flexflow_tpu.simulator.memory import (HBM_SAFETY, dominant_term,
+                                           memory_per_device,
+                                           optimizer_slots,
+                                           weight_state_terms)
+
+# Documented tolerance of the analytic model vs XLA's executable-level
+# accounting: XLA fuses, rematerializes and reuses buffers, so the two
+# legitimately differ — but on the reference models they agree within a
+# factor of 2 (measured ratios: alexnet 0.97, transformer 0.87, DLRM
+# 1.18 on jax 0.4.37 CPU).  A drift outside this band means the model
+# (or an op's tile accounting) broke.
+PRED_VS_XLA_BAND = 2.0
+
+
+@pytest.fixture(autouse=True)
+def _isolated_singleton(monkeypatch):
+    monkeypatch.delenv("FF_TELEMETRY", raising=False)
+    monkeypatch.delenv("FF_TELEMETRY_FILE", raising=False)
+    monkeypatch.delenv("FF_MEMPLANE", raising=False)
+    events.reset_active()
+    yield
+    events.reset_active()
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# unit: term accounting
+# ---------------------------------------------------------------------------
+
+def test_optimizer_slots_mapping():
+    m = ff.FFModel(ff.FFConfig(batch_size=4))
+    assert optimizer_slots(None) == 1                       # search time
+    assert optimizer_slots(ff.SGDOptimizer(lr=0.1)) == 0    # no momentum
+    assert optimizer_slots(ff.SGDOptimizer(lr=0.1, momentum=0.9)) == 1
+    assert optimizer_slots(ff.AdamOptimizer(m, alpha=1e-3)) == 2
+
+
+def test_weight_state_terms_match_legacy_pipeline_budget():
+    # the pipeline search budgeted 3 * 4 * w_elems (master + grad + one
+    # slot); weight_state_terms(w, 1) must be numerically identical so
+    # search decisions did not shift under the refactor
+    w = 12345.0
+    terms = weight_state_terms(w, opt_slots=1)
+    assert sum(terms.values()) == 3.0 * 4.0 * w
+    assert dominant_term({"params": 1.0, "activations": 5.0,
+                          "staging": 2.0}) == "activations"
+
+
+def test_data_parallel_replicates_weights_and_splits_activations(devices):
+    m = ff.FFModel(ff.FFConfig(batch_size=16, workers_per_node=8))
+    inp = m.create_tensor((16, 32), nchw=False)
+    t = m.dense(inp, 64, name="fc")
+    m.softmax(t, name="sm")
+    mem = memory_per_device(m, machine_model=TPUMachineModel(num_devices=8))
+    assert mem["num_devices"] == 8
+    w_bytes = 4.0 * (32 * 64 + 64)  # kernel + bias, f32
+    for row in mem["per_device"]:
+        # every device holds the full (replicated) weight state...
+        assert row["params"] == int(w_bytes)
+        assert row["grads"] == int(w_bytes)
+        # ...and a grad-sized ring-allreduce staging buffer
+        assert row["staging"] >= int(w_bytes)
+    # batch split 8-ways: per-device activations are 1/8 of the batch
+    fc = mem["by_op"]["fc"]
+    assert fc["dims"].startswith("8")
+    assert mem["peak_bytes"] == mem["per_device"][mem["peak_device"]]["total"]
+    assert mem["capacity_bytes"] > 0
+    assert mem["headroom_bytes"] == mem["capacity_bytes"] - mem["peak_bytes"]
+    assert mem["budget_bytes"] == int(HBM_SAFETY * mem["capacity_bytes"])
+
+
+def test_host_sparse_embedding_occupies_no_hbm(devices):
+    m = ff.FFModel(ff.FFConfig(batch_size=8, workers_per_node=1))
+    inp = m.create_tensor((8, 4), dtype="int32", nchw=False)
+    t = m.embedding(inp, 5000, 16, aggr="sum", name="emb")
+    from flexflow_tpu.config import ParallelConfig
+    host_pc = ParallelConfig.host_rowsparse(t.num_dims)
+    mem = memory_per_device(m, strategies={"emb": host_pc})
+    assert mem["by_op"]["emb"]["bytes"] == 0
+    assert mem["by_op"]["emb"]["host"] is True
+
+
+# ---------------------------------------------------------------------------
+# predicted vs compiled.memory_analysis() — the cross-check the
+# observatory exists for
+# ---------------------------------------------------------------------------
+
+def _train_one_step_with_plane(monkeypatch, tmp_path, build):
+    trace = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", trace)
+    monkeypatch.setenv("FF_MEMPLANE", "1")
+    events.reset_active()
+    m = build()
+    m.sync()
+    recs = _read_jsonl(trace)
+    pred = [r for r in recs if r.get("name") == "memory_predicted"][-1]
+    xla = [r for r in recs if r.get("name") == "xla_memory"
+           and r["attrs"]["site"] == "train_step"][-1]
+    return pred["attrs"], xla["attrs"]
+
+
+def _assert_band(pred, xla):
+    ratio = pred["peak_bytes"] / max(xla["total_bytes"], 1)
+    assert 1.0 / PRED_VS_XLA_BAND <= ratio <= PRED_VS_XLA_BAND, (
+        f"predicted {pred['peak_bytes']} vs XLA {xla['total_bytes']} "
+        f"(ratio {ratio:.2f}) outside the documented "
+        f"factor-of-{PRED_VS_XLA_BAND:g} band")
+
+
+def test_predicted_tracks_xla_alexnet(devices, tmp_path, monkeypatch):
+    def build():
+        from flexflow_tpu.models.alexnet import build_alexnet
+        m = ff.FFModel(ff.FFConfig(batch_size=8, workers_per_node=1))
+        inp, _ = build_alexnet(m, 8)
+        m.compile(ff.SGDOptimizer(lr=0.01),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+        m.init_layers(seed=0)
+        dl = ff.DataLoader.synthetic(m, inp, num_samples=8)
+        dl.next_batch(m)
+        m.train_iteration()
+        return m
+
+    pred, xla = _train_one_step_with_plane(monkeypatch, tmp_path, build)
+    _assert_band(pred, xla)
+    # weight state dominates alexnet at batch 8 (245M params vs 18 MiB
+    # of activations)
+    assert pred["dominant_term"] == "params"
+
+
+def test_predicted_tracks_xla_transformer(devices, tmp_path, monkeypatch):
+    def build():
+        from flexflow_tpu.models.transformer import build_transformer
+        m = ff.FFModel(ff.FFConfig(batch_size=4, workers_per_node=1))
+        toks, pos, _ = build_transformer(m, 4, seq_length=32, num_layers=2,
+                                         embed_dim=64, num_heads=4,
+                                         vocab_size=1000)
+        m.compile(ff.SGDOptimizer(lr=0.01),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+        m.init_layers(seed=0)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 1000, (4, 32), dtype=np.int32)
+        p = np.tile(np.arange(32, dtype=np.int32), (4, 1))
+        y = rng.integers(0, 1000, (4, 32), dtype=np.int32)
+        dl = ff.DataLoader(m, {toks: x, pos: p}, y)
+        dl.next_batch(m)
+        m.train_iteration()
+        return m
+
+    pred, xla = _train_one_step_with_plane(monkeypatch, tmp_path, build)
+    _assert_band(pred, xla)
+
+
+def test_predicted_tracks_xla_dlrm(devices, tmp_path, monkeypatch):
+    def build():
+        from flexflow_tpu.models.dlrm import build_dlrm, synthetic_batch
+        sizes = [100, 100, 50]
+        m = ff.FFModel(ff.FFConfig(batch_size=16, workers_per_node=1))
+        sparse_in, dense_in, _ = build_dlrm(
+            m, 16, embedding_sizes=sizes, embedding_bag_size=2,
+            sparse_feature_size=8, mlp_bot=[4, 16, 8],
+            mlp_top=[32, 16, 1])
+        m.compile(ff.SGDOptimizer(lr=0.05), "mean_squared_error",
+                  ["mean_squared_error"])
+        m.init_layers(seed=0)
+        sparse, dense, labels = synthetic_batch(16, sizes, 2, 4)
+        bi = {t: a for t, a in zip(sparse_in, sparse)}
+        bi[dense_in] = dense
+        dl = ff.DataLoader(m, bi, labels)
+        dl.next_batch(m)
+        m.train_iteration()
+        return m
+
+    pred, xla = _train_one_step_with_plane(monkeypatch, tmp_path, build)
+    _assert_band(pred, xla)
+
+
+# ---------------------------------------------------------------------------
+# pipeline search: rejection names the dominant term
+# ---------------------------------------------------------------------------
+
+def test_pipeline_rejection_names_dominant_term(devices):
+    from flexflow_tpu.simulator.cost_model import CostModel
+    from flexflow_tpu.simulator.pipeline_search import cost_pipeline_plan
+
+    cfg = ff.FFConfig(batch_size=32, workers_per_node=8)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((32, 64), nchw=False)
+    t = inp
+    for i in range(6):
+        t = m.dense(t, 64, activation="relu", name=f"fc{i}")
+    m.softmax(m.dense(t, 10, name="head"), name="sm")
+
+    mm_small = TPUMachineModel(num_devices=8, hbm_capacity=1.2e5)
+    cost = CostModel(mm_small, measure=False)
+    reject = {}
+    r = cost_pipeline_plan(m, mm_small, cost, S=4, dp=2, microbatches=16,
+                           remat=False, reject_out=reject)
+    assert r is None
+    # the out-param names what blew the budget and by how much
+    assert reject["reason"].startswith("hbm:")
+    assert reject["reason"].split(":", 1)[1] in (
+        "params", "grads", "optimizer", "activations", "staging")
+    assert reject["mem_bytes"] > reject["budget_bytes"]
+    assert reject["budget_bytes"] == int(HBM_SAFETY * 1.2e5)
+    assert set(reject["terms"]) >= {"params", "grads", "optimizer",
+                                    "activations"}
+
+
+# ---------------------------------------------------------------------------
+# provenance sidecar: hbm_per_device_bytes stamp
+# ---------------------------------------------------------------------------
+
+def test_sidecar_carries_hbm_per_device(devices):
+    from flexflow_tpu.observability.searchtrace import build_provenance
+
+    m = ff.FFModel(ff.FFConfig(batch_size=16, workers_per_node=8))
+    inp = m.create_tensor((16, 8), nchw=False)
+    t = m.dense(inp, 16, activation="relu", name="fc1")
+    m.softmax(m.dense(t, 4, name="fc2"), name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+              ["accuracy"])
+    prov = build_provenance(m, m._all_strategies(), engine="test",
+                            budget=0, seed=0,
+                            machine_model=TPUMachineModel(num_devices=8))
+    hbm = prov["hbm_per_device_bytes"]
+    assert isinstance(hbm, list) and len(hbm) == 8
+    assert all(isinstance(b, int) and b >= 0 for b in hbm)
+    assert prov["hbm_peak_bytes"] == max(hbm) > 0
+    assert prov["hbm_dominant_term"] in ("params", "grads", "optimizer",
+                                         "activations", "staging")
+    assert prov["hbm_capacity_bytes"] > prov["hbm_peak_bytes"]

@@ -1,0 +1,160 @@
+"""memory_report CLI tests: three-view folding on a synthetic trace,
+divergence flagging, missing-view tolerance, and a byte-exact golden
+check (the report is a committed artifact format — changes must be
+deliberate)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+from flexflow_tpu.tools import memory_report
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "memory_report.md")
+
+
+def synthetic_records():
+    """Deterministic mini-trace exercising every report section: a
+    predicted view, two executables (one retraced), live gauges from two
+    devices plus the serving KV pool, and a live/XLA ratio far enough
+    out to trip the divergence flag."""
+    recs = [{"t": "meta", "version": 1, "run_id": "golden-run", "pid": 7,
+             "unix_time": 1700000000.0}]
+    recs.append({"t": "event", "name": "memory_predicted", "ts": 0.5,
+                 "attrs": {"num_devices": 8, "peak_bytes": 480 * 2**20,
+                           "peak_device": 3, "dominant_term": "params",
+                           "terms": {"params": 220 * 2**20,
+                                     "grads": 220 * 2**20,
+                                     "optimizer": 0,
+                                     "activations": 30 * 2**20,
+                                     "staging": 10 * 2**20},
+                           "capacity_bytes": 16 * 2**30,
+                           "headroom_bytes": 16 * 2**30 - 480 * 2**20,
+                           "opt_slots": 0,
+                           "by_op": {"fc1": 300 * 2**20,
+                                     "conv1": 120 * 2**20,
+                                     "sm": 2**20}}})
+    recs.append({"t": "event", "name": "compile_done", "ts": 1.0,
+                 "attrs": {"site": "train_step", "fingerprint": "aa11",
+                           "wall_s": 3.1, "retrace": False, "aot": True,
+                           "total_compiles": 1, "total_retraces": 0}})
+    recs.append({"t": "counter", "name": "compiles", "v": 1, "total": 1,
+                 "ts": 1.0, "attrs": {"site": "train_step"}})
+    recs.append({"t": "counter", "name": "compile_retraces", "v": 0,
+                 "total": 0, "ts": 1.0, "attrs": {"site": "train_step"}})
+    recs.append({"t": "event", "name": "xla_memory", "ts": 1.0,
+                 "attrs": {"site": "train_step", "fingerprint": "aa11",
+                           "total_bytes": 512 * 2**20,
+                           "argument_bytes": 230 * 2**20,
+                           "output_bytes": 220 * 2**20,
+                           "temp_bytes": 282 * 2**20,
+                           "generated_code_bytes": 2**16,
+                           "alias_bytes": 220 * 2**20}})
+    recs.append({"t": "event", "name": "xla_cost", "ts": 1.0,
+                 "attrs": {"site": "train_step", "fingerprint": "aa11",
+                           "flops": 3.1e10, "bytes_accessed": 2.0e9}})
+    # a serving prefill that retraced once (the failure the plane is for)
+    for i, (fp, retrace) in enumerate([("bb22", False), ("bb33", True)]):
+        recs.append({"t": "event", "name": "compile_done", "ts": 2.0 + i,
+                     "attrs": {"site": "serve_prefill:8",
+                               "fingerprint": fp, "wall_s": 0.4,
+                               "retrace": retrace, "aot": True,
+                               "total_compiles": 2 + i,
+                               "total_retraces": int(retrace)}})
+        recs.append({"t": "counter", "name": "compiles", "v": 1,
+                     "total": 2 + i, "ts": 2.0 + i,
+                     "attrs": {"site": "serve_prefill:8"}})
+        recs.append({"t": "counter", "name": "compile_retraces",
+                     "v": int(retrace), "total": int(retrace),
+                     "ts": 2.0 + i, "attrs": {"site": "serve_prefill:8"}})
+        recs.append({"t": "event", "name": "xla_memory", "ts": 2.0 + i,
+                     "attrs": {"site": "serve_prefill:8",
+                               "fingerprint": fp,
+                               "total_bytes": 64 * 2**20,
+                               "argument_bytes": 40 * 2**20,
+                               "output_bytes": 8 * 2**20,
+                               "temp_bytes": 16 * 2**20,
+                               "generated_code_bytes": 2**14,
+                               "alias_bytes": 0}})
+    # live gauges: device 0 peak deliberately ~4x the largest executable
+    # to trip the divergence flag
+    for dev, kind, v in [("0", "in_use", 1800 * 2**20),
+                         ("0", "peak", 2048 * 2**20),
+                         ("0", "limit", 16 * 2**30),
+                         ("1", "in_use", 500 * 2**20),
+                         ("pool", "kv_blocks", 24 * 2**20)]:
+        recs.append({"t": "gauge", "name": "hbm_bytes", "v": float(v),
+                     "ts": 5.0, "attrs": {"device": dev, "kind": kind}})
+    return recs
+
+
+def write_trace(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_report_sections_and_folding(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    write_trace(path, synthetic_records())
+    report = memory_report.main([path, "-o", str(tmp_path / "r.md")])
+    for section in ("## Predicted (analytic model)", "## Headroom",
+                    "## XLA executables", "## Live HBM", "## Divergence"):
+        assert section in report
+    assert "480.0 MiB" in report          # predicted peak
+    assert "dominant term: params" in report
+    assert "headroom: **15.5 GiB**" in report
+    assert "train_step" in report and "serve_prefill:8" in report
+    assert "**1 retrace(s)**" in report
+    # the out-of-band live/XLA ratio is flagged loudly
+    assert "!! live(peak) / XLA" in report
+    assert "| pool | kv_blocks | 24.0 MiB |" in report
+    assert (tmp_path / "r.md").read_text() == report
+
+
+def test_missing_views_tolerated(tmp_path):
+    # live-only trace (e.g. scraped gauges, no compile plane): the
+    # report renders the absence of the other views, rc stays 0
+    path = str(tmp_path / "live.jsonl")
+    write_trace(path, [{"t": "gauge", "name": "hbm_bytes", "v": 1024.0,
+                        "ts": 1.0, "attrs": {"device": "0",
+                                             "kind": "in_use"}}])
+    report = memory_report.main([path])
+    assert "no `memory_predicted` event" in report
+    assert "no compile events" in report
+    assert "nothing to cross-check" in report
+
+
+def test_empty_and_corrupt_trace(tmp_path):
+    path = str(tmp_path / "e.jsonl")
+    with open(path, "w") as f:
+        f.write('{"t": "event", "name": "xla_mem')  # truncated mid-write
+    report = memory_report.main([path])
+    assert "## Divergence" in report
+
+
+def test_golden_output(tmp_path):
+    """Byte-exact golden: regenerate with
+    ``python tests/test_memory_report.py --regen`` after deliberate
+    format changes."""
+    path = str(tmp_path / "t.jsonl")
+    write_trace(path, synthetic_records())
+    report = memory_report.render(
+        memory_report.fold(memory_report.parse_trace(path)), "golden.jsonl")
+    with open(GOLDEN) as f:
+        assert report == f.read()
+
+
+if __name__ == "__main__" and "--regen" in sys.argv:
+    import tempfile
+
+    tmp = os.path.join(tempfile.mkdtemp(), "t.jsonl")
+    write_trace(tmp, synthetic_records())
+    os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+    with open(GOLDEN, "w") as f:
+        f.write(memory_report.render(
+            memory_report.fold(memory_report.parse_trace(tmp)),
+            "golden.jsonl"))
+    print(f"regenerated {GOLDEN}")

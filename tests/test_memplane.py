@@ -1,0 +1,251 @@
+"""Compile plane (observability/memplane.py): AOT wrapper compile
+events, retrace counting, serving bucket-ladder flatness, and the
+zero-work guarantee when telemetry is off."""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+import flexflow_tpu as ff
+from flexflow_tpu.observability import events, memplane
+
+
+@pytest.fixture(autouse=True)
+def _isolated_singleton(monkeypatch):
+    monkeypatch.delenv("FF_TELEMETRY", raising=False)
+    monkeypatch.delenv("FF_TELEMETRY_FILE", raising=False)
+    monkeypatch.delenv("FF_MEMPLANE", raising=False)
+    events.reset_active()
+    yield
+    events.reset_active()
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _named(recs, name):
+    return [r for r in recs if r.get("name") == name]
+
+
+# ---------------------------------------------------------------------------
+# unit: wrapper around a plain jax.jit callable
+# ---------------------------------------------------------------------------
+
+def test_wrap_emits_compile_once_and_counts_retrace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    plane = memplane.MemPlane(log)
+    fn = plane.wrap("unit", jax.jit(lambda x: jnp.sum(x * 2.0)))
+
+    a = np.ones((4,), np.float32)
+    r1 = fn(a)
+    r2 = fn(a + 1)            # same signature: cached executable, silent
+    assert float(r1) == 8.0 and float(r2) == 16.0
+    recs = _read_jsonl(log.path)
+    dones = _named(recs, "compile_done")
+    assert len(dones) == 1
+    assert dones[0]["attrs"]["site"] == "unit"
+    assert dones[0]["attrs"]["retrace"] is False
+    assert dones[0]["attrs"]["aot"] is True
+    assert dones[0]["attrs"]["wall_s"] > 0
+    # XLA introspection rode along
+    xm = _named(recs, "xla_memory")[0]["attrs"]
+    assert xm["fingerprint"] == dones[0]["attrs"]["fingerprint"]
+    assert xm["total_bytes"] >= 0
+    assert _named(recs, "xla_cost")[0]["attrs"]["flops"] >= 0
+
+    # a NEW shape at the SAME site is a retrace
+    fn(np.ones((8,), np.float32))
+    recs = _read_jsonl(log.path)
+    dones = _named(recs, "compile_done")
+    assert len(dones) == 2
+    assert dones[1]["attrs"]["retrace"] is True
+    assert dones[1]["attrs"]["total_retraces"] == 1
+    retr = [r for r in recs if r["t"] == "counter"
+            and r["name"] == "compile_retraces"]
+    # 0-increment on the first compile keeps the series scrapeable;
+    # the retrace increments the running total to 1
+    assert [r["v"] for r in retr] == [0, 1]
+    assert retr[-1]["total"] == 1
+    log.close()
+
+
+def test_distinct_sites_are_not_retraces(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    plane = memplane.MemPlane(log)
+    f1 = plane.wrap("site_a", jax.jit(lambda x: x + 1))
+    f2 = plane.wrap("site_b", jax.jit(lambda x: x * 3))
+    f1(np.ones((4,), np.float32))
+    f2(np.ones((4,), np.float32))
+    recs = _read_jsonl(log.path)
+    dones = _named(recs, "compile_done")
+    assert len(dones) == 2
+    assert all(d["attrs"]["retrace"] is False for d in dones)
+    assert plane.compiles == 2 and plane.retraces == 0
+    log.close()
+
+
+def test_scalar_args_key_by_type_not_value(tmp_path):
+    # jit keys weak-typed python scalars by type: calling with 2 then 3
+    # must NOT retrace (the serving slot index rides this path)
+    import jax
+
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    plane = memplane.MemPlane(log)
+    fn = plane.wrap("scalars", jax.jit(lambda x, i: x + i))
+    fn(np.ones((4,), np.float32), 2)
+    fn(np.ones((4,), np.float32), 3)
+    assert len(_named(_read_jsonl(log.path), "compile_done")) == 1
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# integration: training emits the compile plane
+# ---------------------------------------------------------------------------
+
+def _tiny_model(batch=16):
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="float32")
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((batch, 8), nchw=False)
+    t = m.dense(inp, 16, activation=ff.ActiMode.RELU)
+    m.softmax(m.dense(t, 4))
+    return m, inp
+
+
+def _train_steps(m, inp, steps):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((m.config.batch_size * steps, 8), np.float32)
+    y = rng.integers(0, 4, (m.config.batch_size * steps, 1), dtype=np.int32)
+    dl = ff.DataLoader(m, {inp: x}, y)
+    for _ in range(steps):
+        dl.next_batch(m)
+        m.train_iteration()
+
+
+def test_training_emits_compile_plane(devices, tmp_path, monkeypatch):
+    trace = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", trace)
+    monkeypatch.setenv("FF_MEMPLANE", "1")
+    events.reset_active()
+    m, inp = _tiny_model()
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=0)
+    _train_steps(m, inp, 3)
+    m.sync()
+    recs = _read_jsonl(trace)
+    dones = _named(recs, "compile_done")
+    # ONE train_step compile across 3 steps — steady state is a dict hit
+    ts = [d for d in dones if d["attrs"]["site"] == "train_step"]
+    assert len(ts) == 1 and ts[0]["attrs"]["retrace"] is False
+    xm = [r["attrs"] for r in _named(recs, "xla_memory")
+          if r["attrs"]["site"] == "train_step"]
+    assert len(xm) == 1 and xm[0]["total_bytes"] > 0
+    assert xm[0]["temp_bytes"] >= 0
+    xc = [r["attrs"] for r in _named(recs, "xla_cost")
+          if r["attrs"]["site"] == "train_step"]
+    assert len(xc) == 1 and xc[0]["flops"] > 0
+    # the predicted view landed in the same trace
+    assert len(_named(recs, "memory_predicted")) == 1
+
+
+def test_memplane_off_by_default(devices, tmp_path, monkeypatch):
+    # FF_TELEMETRY alone must NOT pay for the AOT wrapper
+    trace = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", trace)
+    events.reset_active()
+    m, inp = _tiny_model()
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    assert m._memplane is None
+    m.init_layers(seed=0)
+    _train_steps(m, inp, 1)
+    m.sync()
+    recs = _read_jsonl(trace)
+    assert not _named(recs, "compile_done")
+    # the predicted view is telemetry-gated, not FF_MEMPLANE-gated
+    assert len(_named(recs, "memory_predicted")) == 1
+
+
+def test_disabled_zero_event_log_calls(devices, tmp_path, monkeypatch):
+    """FF_MEMPLANE=1 WITHOUT FF_TELEMETRY: no plane, no trace file, and
+    literally zero event-log calls (any write would raise)."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("FF_MEMPLANE", "1")
+    monkeypatch.setattr(
+        events.EventLog, "_write",
+        lambda self, rec: (_ for _ in ()).throw(
+            AssertionError(f"event-log call while disabled: {rec}")))
+    m, inp = _tiny_model()
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    assert m._memplane is None
+    m.init_layers(seed=0)
+    _train_steps(m, inp, 1)
+    m.sync()
+    assert not (tmp_path / "ff_trace.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# serving: the bucket ladder stays retrace-flat
+# ---------------------------------------------------------------------------
+
+def test_serving_ladder_is_retrace_flat(devices, tmp_path, monkeypatch):
+    """Mixed prompt lengths across a warm {4, 8} bucket ladder: every
+    serving executable (per-bucket prefill, shared step, insert) compiles
+    exactly once and the cumulative retrace counter stays 0 — the silent
+    failure mode this plane exists to catch."""
+    from flexflow_tpu.models.transformer import build_transformer
+    from flexflow_tpu.serving.engine import InferenceEngine
+
+    trace = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("FF_TELEMETRY", "1")
+    monkeypatch.setenv("FF_TELEMETRY_FILE", trace)
+    monkeypatch.setenv("FF_MEMPLANE", "1")
+    events.reset_active()
+    V, max_seq = 32, 64
+    m = ff.FFModel(ff.FFConfig(batch_size=4))
+    build_transformer(m, 4, seq_length=max_seq, num_layers=1,
+                      embed_dim=16, num_heads=2, vocab_size=V)
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=3)
+
+    eng = InferenceEngine(m, max_batch=2, max_seq=max_seq,
+                          buckets=(4, 8), max_new_tokens=4)
+    assert eng._memplane is not None
+    rng = np.random.default_rng(5)
+    with eng:
+        # two passes over the ladder: the second is fully warm
+        for _ in range(2):
+            hs = [eng.submit(rng.integers(0, V, size=n).astype(np.int32), 3)
+                  for n in (3, 4, 5, 7, 8)]
+            for h in hs:
+                h.result(300)
+    recs = _read_jsonl(trace)
+    serve_dones = [r["attrs"] for r in _named(recs, "compile_done")
+                   if r["attrs"]["site"].startswith("serve_")]
+    assert serve_dones, "serving compiles did not ride the plane"
+    # every serving site compiled exactly once...
+    sites = [d["site"] for d in serve_dones]
+    assert len(sites) == len(set(sites)), f"site recompiled: {sites}"
+    # ...and nothing anywhere counted as a retrace
+    assert all(d["retrace"] is False for d in serve_dones)
+    assert eng._memplane.retraces == 0
+    # per-bucket prefill sites are distinct by design (a shared site
+    # would make the ladder LOOK like retraces)
+    prefills = [s for s in sites if "prefill" in s]
+    assert len(prefills) == 2
