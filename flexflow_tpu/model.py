@@ -818,7 +818,11 @@ class FFModel:
             self._opprof = None
             self._memplane = None
             return self._compile_impl(optimizer, loss_type, metrics, machine)
-        with self._telemetry.span("compile", num_ops=len(self.ops)) as at:
+        from .observability.reqtrace import run_trace_id as _ff_run_trace
+
+        with self._telemetry.span(
+                "compile", num_ops=len(self.ops),
+                trace_id=_ff_run_trace(self._telemetry.run_id)) as at:
             self._compile_impl(optimizer, loss_type, metrics, machine)
             at["num_devices"] = self.machine.num_devices
             at["batch_size"] = self.config.batch_size
@@ -1054,8 +1058,13 @@ class FFModel:
         if strategies is not None:
             cfg.strategies.update(strategies)
         tel = self._telemetry
-        span = tel.span("recompile", num_ops=len(self.ops)) \
-            if tel is not None else contextlib.nullcontext({})
+        if tel is not None:
+            from .observability.reqtrace import run_trace_id as _ff_run_trace
+
+            span = tel.span("recompile", num_ops=len(self.ops),
+                            trace_id=_ff_run_trace(tel.run_id))
+        else:
+            span = contextlib.nullcontext({})
         try:
             with span as at:
                 self._compile_impl(

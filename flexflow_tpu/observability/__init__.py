@@ -52,15 +52,34 @@ the hot path performs ZERO event-log calls — every site guards on a
                 strategy-file ``.meta.json`` sidecar carries.  Folded
                 by ``tools/search_report.py`` (report + strategy
                 ``--diff``).
+``reqtrace``  — end-to-end request tracing: a ``TraceContext``
+                (trace id, span id, ``FF_TRACE_SAMPLE`` sampling
+                decision made once at admission) carried on every
+                ``InferenceRequest`` and stamped onto the serve
+                records, so one request's queue wait, prefill, decode
+                chunks, KV events, and failover/hedge attempts join
+                under one id — ``tools/timeline_export.py`` folds them
+                into a Perfetto timeline.  Training runs carry a
+                run-level trace id on step/compile/reconfig spans.
+``slo``       — declarative serving SLOs (TTFT / TPOT / queue wait /
+                availability via ``FF_SLO_*``) evaluated as multi-
+                window burn rates over the same event tap, exported as
+                ``ff_slo_burn_rate{slo,window}`` /
+                ``ff_slo_budget_remaining{slo}`` gauges plus an
+                hysteresis-guarded ``slo_alert`` event.
 """
 
-from . import chipwatch, events, health, metrics, opprof, searchtrace
+from . import (chipwatch, events, health, metrics, opprof, reqtrace,
+               searchtrace, slo)
 from .events import EventLog, active_log, for_config
 from .health import HealthMonitor, read_heartbeat, write_heartbeat
 from .metrics import MetricsRegistry
+from .reqtrace import TraceContext
 from .searchtrace import SearchRecorder
+from .slo import BurnRateEvaluator, SLOTarget
 
-__all__ = ["EventLog", "HealthMonitor", "MetricsRegistry",
-           "SearchRecorder", "active_log", "chipwatch", "events",
+__all__ = ["BurnRateEvaluator", "EventLog", "HealthMonitor",
+           "MetricsRegistry", "SLOTarget", "SearchRecorder",
+           "TraceContext", "active_log", "chipwatch", "events",
            "for_config", "health", "metrics", "opprof", "read_heartbeat",
-           "searchtrace", "write_heartbeat"]
+           "reqtrace", "searchtrace", "slo", "write_heartbeat"]

@@ -33,6 +33,7 @@ import io
 import itertools
 import json
 import os
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
@@ -74,7 +75,9 @@ class EventLog:
         self.totals: Dict[str, float] = {}
         # Observers see every written record (observability/health.py
         # taps spans for straggler attribution).  Called OUTSIDE the
-        # lock: an observer may emit records of its own.
+        # lock: an observer may emit records of its own.  One that
+        # raises is detached with a one-time warning — it must not
+        # poison the emitting thread (see _drop_observer).
         self._observers: list = []
 
     # -- clock ----------------------------------------------------------
@@ -92,6 +95,23 @@ class EventLog:
         with self._lock:
             if fn not in self._observers:
                 self._observers.append(fn)
+
+    def _drop_observer(self, fn, exc: BaseException) -> None:
+        """Detach an observer that raised.  The fan-out runs on whatever
+        thread wrote the record (an engine loop, the pool monitor, an
+        HTTP handler) — one broken observer must not poison them all on
+        every subsequent record.  Removal is CAS-like under the lock, so
+        when several emitting threads hit the same broken observer
+        concurrently exactly one wins and prints the one-time warning."""
+        with self._lock:
+            try:
+                self._observers.remove(fn)
+            except ValueError:
+                return  # another thread already detached + warned
+        print(f"flexflow_tpu: telemetry observer {fn!r} raised "
+              f"{type(exc).__name__}: {exc} — detached (records keep "
+              f"flowing to the sink and remaining observers)",
+              file=sys.stderr)
 
     # -- sink -----------------------------------------------------------
     def _write(self, rec: Dict[str, Any]) -> None:
@@ -114,8 +134,8 @@ class EventLog:
         for fn in observers:
             try:
                 fn(rec)
-            except Exception:
-                pass  # observers never break the sink
+            except Exception as e:  # noqa: BLE001 — observer quarantine
+                self._drop_observer(fn, e)
 
     def flush(self) -> None:
         with self._lock:

@@ -54,7 +54,7 @@ from . import events
 # attr keys that may become Prometheus labels; everything else is
 # dropped from the label set (NOT from the trace) to bound cardinality
 LABEL_KEYS = ("device", "event", "kind", "op", "outcome", "phase", "reason",
-              "replica", "scope", "site", "src", "status", "which",
+              "replica", "scope", "site", "slo", "src", "status", "which",
               "window")
 
 # histogram quantiles exposed on every summary series
@@ -481,6 +481,12 @@ def maybe_start(log: Optional[events.EventLog] = None) \
                 _attached_logs.append(tap)
         if fresh:
             reg.attach(tap)
+            # the SLO burn-rate evaluator rides the same tap: its
+            # verdicts come back through the log as slo_* gauges, which
+            # the registry just attached to this log will fold
+            from . import slo
+
+            slo.maybe_attach(tap)
     return reg
 
 
@@ -494,3 +500,6 @@ def stop() -> None:
             _server = None
         _registry = None
         _attached_logs.clear()
+    from . import slo
+
+    slo.reset()  # the evaluators attached alongside the registry

@@ -35,6 +35,7 @@ import numpy as np
 
 from .events import EventLog
 from .health import write_heartbeat
+from .reqtrace import run_trace_id
 
 # Memory gauges are cheap but chatty; sample every N steps.
 MEM_GAUGE_EVERY = 8
@@ -117,6 +118,10 @@ class StepStats:
     def __init__(self, model, log: EventLog):
         self.model = model
         self.log = log
+        # run-level trace id: step spans join the same timeline as the
+        # serving plane's request traces (derived from run_id — stable,
+        # zero per-step state)
+        self.trace_id = run_trace_id(log.run_id)
         self.steps = 0
         self.sync_each_step = bool(os.environ.get("FF_TELEMETRY_SYNC"))
         self._fwd_flops_per_sample: Optional[float] = None
@@ -159,7 +164,7 @@ class StepStats:
         # fwd + dgrad + wgrad ~= 3x forward (reference backward accounting)
         mfu = (3.0 * fwd_fps * sps / (nd * peak)) if peak else 0.0
         log.span_at("step", t0, dur, step=step_idx, first=first,
-                    batch_size=bs,
+                    trace_id=self.trace_id, batch_size=bs,
                     samples_per_sec=round(sps, 2),
                     samples_per_sec_per_chip=round(sps / nd, 2),
                     mfu=round(mfu, 6))

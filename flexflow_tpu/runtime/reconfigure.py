@@ -500,7 +500,11 @@ class ReconfigurationController:
             from ..observability.events import active_log
             log = active_log()
         if log is not None:
-            log.event(name, **attrs)
+            from ..observability.reqtrace import run_trace_id
+
+            # run-level trace id: reconfig events land on the same
+            # timeline track family as step/compile spans
+            log.event(name, trace_id=run_trace_id(log.run_id), **attrs)
             log.flush()
 
     def close(self) -> None:

@@ -14,7 +14,9 @@ Endpoints::
   POST /generate   {"prompt": [int, ...], "max_new_tokens": 16,
                     "priority": 0, "timeout_s": 30, "eos_id": null}
               ->   200 {"request_id": .., "tokens": [..],
-                        "queue_wait_s": .., "ttft_s": .., "tpot_s": ..}
+                        "queue_wait_s": .., "ttft_s": .., "tpot_s": ..,
+                        "trace_id": ..}   (trace_id when telemetry is on
+                        — the join key into the event log / timeline)
               ->   400 malformed body / validation error
               ->   503 queue-wait timeout      (Retry-After: 1)
               ->   503 admission shed          (Retry-After: estimate)
@@ -146,20 +148,24 @@ class _Handler(BaseHTTPRequestHandler):
         except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"{type(e).__name__}: {e}"})
             return
+        # trace_id in every reply that has a request: the client-side
+        # join key for timeline_export / exemplar reporting
+        trace = {"trace_id": req.trace.trace_id} \
+            if req.trace is not None else {}
         try:
             tokens = req.result(self.api.result_timeout_s)
         except ServeTimeout as e:
             self._reply(503, {"error": str(e),
-                              "request_id": req.request_id},
+                              "request_id": req.request_id, **trace},
                         Retry_After=1)
             return
         except ServeError as e:
             self._reply(500, {"error": str(e),
-                              "request_id": req.request_id})
+                              "request_id": req.request_id, **trace})
             return
         out = {"request_id": req.request_id,
                "tokens": [int(t) for t in tokens],
-               "prompt_len": int(req.prompt.size)}
+               "prompt_len": int(req.prompt.size), **trace}
         for k in ("queue_wait_s", "ttft_s", "tpot_s"):
             v = getattr(req, k)
             if v is not None:

@@ -43,7 +43,11 @@ Observability (when the model was compiled with telemetry): per-request
 ``serve_request_done`` event carrying TTFT/TPOT, ``serve_tokens`` /
 ``serve_requests`` counters and a per-token-boundary
 ``serve_batch_occupancy`` gauge — ``tools/serve_report.py`` folds them
-into latency percentiles and an occupancy timeline.
+into latency percentiles and an occupancy timeline.  Every record is
+additionally stamped with the request's ``trace_id``
+(observability/reqtrace.py); a SAMPLED request (FF_TRACE_SAMPLE) also
+gets per-chunk ``serve_decode_chunk`` spans and KV block span events,
+which ``tools/timeline_export.py`` folds into one Perfetto track.
 
 Fault isolation: a request whose admission/prefill raises (including an
 ``FF_CHAOS`` ``serve`` fault) fails ALONE — the batch loop and every
@@ -61,6 +65,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import reqtrace as _reqtrace
 from ..testing.chaos import ChaosReplicaKill
 from .config import ServeConfig
 from .kvpool import BlockExhausted, KVBlockPool, blocks_for
@@ -73,7 +78,7 @@ _engine_uids = itertools.count(1)
 class _Slot:
     """Host-side state of one running sequence."""
 
-    __slots__ = ("req", "pos", "t_first", "res")
+    __slots__ = ("req", "pos", "t_first", "res", "tr_t0", "tr_n0")
 
     def __init__(self, req: InferenceRequest, pos: int, t_first: float,
                  res=None):
@@ -81,6 +86,10 @@ class _Slot:
         self.pos = pos          # position the NEXT fed token occupies
         self.t_first = t_first
         self.res = res          # kvpool.Reservation (paged mode only)
+        # decode-chunk tracking for SAMPLED traces: start clock + token
+        # count at the current chunk's open edge (None: not sampled)
+        self.tr_t0: Optional[float] = None
+        self.tr_n0 = 0
 
 
 class InferenceEngine:
@@ -137,6 +146,10 @@ class InferenceEngine:
 
         self._telemetry = telemetry if telemetry is not None \
             else getattr(model, "_telemetry", None)
+        # decode tokens per serve_decode_chunk span on a sampled trace
+        # (loud parse; resolved once — 0 with telemetry off)
+        self._trace_chunk = _reqtrace.chunk_tokens_from_env() \
+            if self._telemetry is not None else 0
         # Compile plane (FF_MEMPLANE): wraps every bucket-ladder jit so
         # a silent retrace — THE serving failure mode — shows up as a
         # compile_done{retrace} event and on ff_compile_retraces_total.
@@ -436,6 +449,10 @@ class InferenceEngine:
             # when even evicting the whole prefix index couldn't cover
             # this request's worst case on top of in-flight promises
             self._kvpool.check_room(int(req.prompt.size), n)
+        # trace context minted ONCE, here at admission (pool attempts
+        # arrive on the shared queue already carrying a child context)
+        if self._telemetry is not None and req.trace is None:
+            req.trace = _reqtrace.begin(self._telemetry)
         self._stats["submitted"] += 1
         self._queue.put(req)
         return req
@@ -628,20 +645,31 @@ class InferenceEngine:
         self._stats["admitted"] += 1
         log = self._telemetry
         if log is not None:
+            tr = _reqtrace.tag(req.trace)
             log.span_at("serve_queue_wait", req.t_submit,
                         req.t_admit - req.t_submit,
-                        request_id=req.request_id, priority=req.priority)
+                        request_id=req.request_id, priority=req.priority,
+                        **tr)
             log.span_at("serve_prefill", t0, t1 - t0,
                         request_id=req.request_id, prompt_len=plen,
-                        bucket=bucket, slot=slot, replica=self.name)
+                        bucket=bucket, slot=slot, replica=self.name, **tr)
         if req.max_new_tokens == 1 or first_tok == req.eos_id:
             self._finish(req, slot=None, t_done=t1)
             return
-        self._slots[slot] = _Slot(req, plen, t_first=t1)
+        self._slots[slot] = self._new_slot(req, plen, t1)
         self._toks[slot] = first_tok
         self._pos[slot] = plen
         self._stats["max_active"] = max(self._stats["max_active"],
                                         self.num_active)
+
+    def _new_slot(self, req: InferenceRequest, plen: int, t1: float,
+                  res=None) -> _Slot:
+        s = _Slot(req, plen, t_first=t1, res=res)
+        if self._trace_chunk and req.trace is not None \
+                and req.trace.sampled:
+            s.tr_t0 = t1                # open the first decode chunk
+            s.tr_n0 = len(req.tokens)
+        return s
 
     def _admit_paged(self, req: InferenceRequest, slot: int) -> None:
         """Block-paged admission: reserve blocks (worst case promised so
@@ -690,22 +718,30 @@ class InferenceEngine:
         self._stats["admitted"] += 1
         log = self._telemetry
         if log is not None:
+            tr = _reqtrace.tag(req.trace)
             log.span_at("serve_queue_wait", req.t_submit,
                         req.t_admit - req.t_submit,
-                        request_id=req.request_id, priority=req.priority)
+                        request_id=req.request_id, priority=req.priority,
+                        **tr)
             log.span_at("serve_prefill", t0, t1 - t0,
                         request_id=req.request_id, prompt_len=plen,
-                        bucket=sbucket, slot=slot, replica=self.name)
+                        bucket=sbucket, slot=slot, replica=self.name, **tr)
             if m > 0:
                 log.counter("serve_prefix_hits", 1)
                 log.counter("serve_prefill_tokens_saved", m)
             else:
                 log.counter("serve_prefix_misses", 1)
+            if req.trace is not None and req.trace.sampled:
+                # the admission's KV story (alloc / prefix share / COW)
+                # as span events on the request's trace
+                for ev_name, ev_attrs in res.trace_events():
+                    log.event(ev_name, request_id=req.request_id,
+                              replica=self.name, **ev_attrs, **tr)
         if req.max_new_tokens == 1 or first_tok == req.eos_id:
             pool.release(res)
             self._finish(req, slot=None, t_done=t1)
             return
-        self._slots[slot] = _Slot(req, plen, t_first=t1, res=res)
+        self._slots[slot] = self._new_slot(req, plen, t1, res=res)
         self._toks[slot] = first_tok
         self._pos[slot] = plen
         self._stats["max_active"] = max(self._stats["max_active"],
@@ -801,14 +837,33 @@ class InferenceEngine:
             slot.pos += 1
             self._pos[i] = slot.pos
             self._toks[i] = tok
+            if slot.tr_t0 is not None and \
+                    len(slot.req.tokens) - slot.tr_n0 >= self._trace_chunk:
+                self._emit_chunk(slot, t_now)
             if (len(slot.req.tokens) >= slot.req.max_new_tokens
                     or tok == slot.req.eos_id):
                 self._finish(slot.req, slot=i, t_done=t_now)
+
+    def _emit_chunk(self, slot: _Slot, t_now: float) -> None:
+        """Close the open decode chunk of a SAMPLED request: one span
+        per FF_TRACE_CHUNK token boundaries, so a long decode renders
+        as a train of chunks instead of one opaque bar."""
+        req = slot.req
+        n = len(req.tokens)
+        self._telemetry.span_at(
+            "serve_decode_chunk", slot.tr_t0, t_now - slot.tr_t0,
+            request_id=req.request_id, token_from=slot.tr_n0,
+            token_to=n, replica=self.name, **_reqtrace.tag(req.trace))
+        slot.tr_t0 = t_now
+        slot.tr_n0 = n
 
     def _finish(self, req: InferenceRequest, slot: Optional[int],
                 t_done: float) -> None:
         if slot is not None:
             s = self._slots[slot]
+            if s is not None and s.tr_t0 is not None \
+                    and len(req.tokens) > s.tr_n0:
+                self._emit_chunk(s, t_done)   # flush the partial chunk
             if s is not None and s.res is not None:
                 self._kvpool.release(s.res)  # unused promise returns too
             self._slots[slot] = None
@@ -824,13 +879,15 @@ class InferenceEngine:
         log = self._telemetry
         if log is None:
             return
+        tr = _reqtrace.tag(req.trace)
         if req.t_first is not None and req.t_done is not None:
             log.span_at("serve_decode", req.t_first,
                         req.t_done - req.t_first,
-                        request_id=req.request_id, tokens=len(req.tokens))
+                        request_id=req.request_id, tokens=len(req.tokens),
+                        **tr)
         attrs = dict(request_id=req.request_id, status=req.status,
                      prompt_len=int(req.prompt.size),
-                     new_tokens=len(req.tokens), replica=self.name)
+                     new_tokens=len(req.tokens), replica=self.name, **tr)
         for k in ("queue_wait_s", "ttft_s", "tpot_s"):
             v = getattr(req, k)
             if v is not None:

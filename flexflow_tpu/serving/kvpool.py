@@ -84,6 +84,26 @@ class Reservation:
         """Block ids in sequence order (shared prefix, then owned)."""
         return self.shared + self.owned
 
+    def trace_events(self) -> List[Tuple[str, Dict[str, int]]]:
+        """This admission's KV story as (name, attrs) pairs — the
+        engine stamps them onto a SAMPLED request's trace as span
+        events (``kv_alloc`` always; ``kv_prefix_hit`` when an indexed
+        prefix was shared; ``kv_cow`` when the partial tail block was
+        copied rather than shared).  Computed here so the accounting
+        stays next to the ownership rules it describes."""
+        out: List[Tuple[str, Dict[str, int]]] = [
+            ("kv_alloc", {"owned_blocks": len(self.owned),
+                          "promised_blocks": self.promised,
+                          "total_blocks": self.total_blocks})]
+        if self.hit_tokens > 0:
+            out.append(("kv_prefix_hit",
+                        {"hit_tokens": self.hit_tokens,
+                         "shared_blocks": len(self.shared),
+                         "prompt_len": self.plen}))
+        if self.cow:
+            out.append(("kv_cow", {"hit_tokens": self.hit_tokens}))
+        return out
+
 
 class _IndexEntry:
     __slots__ = ("chain", "tokens_len")

@@ -74,6 +74,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..observability import reqtrace as _reqtrace
 from ..runtime.resilience import backoff_delay
 from .config import ServeConfig
 from .engine import InferenceEngine
@@ -243,6 +244,11 @@ class ReplicaPool:
             raise ServeError("pool is not accepting requests "
                              "(not started, draining, or stopped)")
         self._check_admission()
+        # root trace context: minted ONCE here at admission; every
+        # attempt gets a child context in _dispatch so failover/hedge
+        # races render as sibling spans under one trace_id
+        if self._telemetry is not None:
+            client.trace = _reqtrace.begin(self._telemetry)
         st = _Client(client)
         with self._lock:
             self._stats["submitted"] += 1
@@ -250,6 +256,8 @@ class ReplicaPool:
             client.add_done_callback(
                 lambda r, st=st: self._on_client_done(st, r))
             self._dispatch(st, first=True)
+        if client.trace is not None and client.trace.sampled:
+            client.add_done_callback(self._emit_request_span)
         return client
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
@@ -308,12 +316,44 @@ class ReplicaPool:
             c.t_submit = now
         att.t_submit = c.t_submit    # queue-wait stays the CALLER's clock
         att.avoid = avoid
+        if c.trace is not None:
+            # child span per attempt: the engine's queue-wait/prefill/
+            # decode records parent to THIS attempt, so two racing
+            # attempts never interleave on one span
+            att.trace = c.trace.child()
+            if att.trace.sampled:
+                att.add_done_callback(self._emit_attempt_span)
         st.attempts.append(att)
         self._attempts[att.request_id] = st
         att.add_done_callback(
             lambda a, st=st: self._on_attempt_done(st, a))
         self._queue.put(att)
         return att
+
+    def _emit_request_span(self, req: InferenceRequest) -> None:
+        """Root span of a SAMPLED client request: submit -> resolution.
+        Fires once, on whichever thread resolved the client."""
+        log = self._telemetry
+        if log is None or req.t_submit is None:
+            return
+        t1 = req.t_done if req.t_done is not None else time.perf_counter()
+        log.span_at("serve_request", req.t_submit, t1 - req.t_submit,
+                    request_id=req.request_id, status=req.status,
+                    **req.trace.ids())
+
+    def _emit_attempt_span(self, att: InferenceRequest) -> None:
+        """One attempt's span (child of the client root).  Starts on the
+        CALLER's submit clock — the engine's serve_queue_wait span for
+        this attempt then nests inside it even after a failover."""
+        log = self._telemetry
+        if log is None or att.t_submit is None:
+            return
+        t1 = att.t_done if att.t_done is not None else time.perf_counter()
+        inc = att.admitted_by or ""
+        log.span_at("serve_attempt", att.t_submit, t1 - att.t_submit,
+                    request_id=att.request_id, status=att.status,
+                    replica=inc.split("#")[0], incarnation=inc,
+                    **att.trace.ids())
 
     def _on_attempt_done(self, st: _Client, att: InferenceRequest) -> None:
         """An attempt resolved (any thread).  Tracked attempts transfer
@@ -391,7 +431,7 @@ class ReplicaPool:
                 log.event("request_failover",
                           request_id=st.req.request_id,
                           from_replica=rep.name, attempt=new.request_id,
-                          reason=reason)
+                          reason=reason, **_reqtrace.tag(st.req.trace))
                 log.counter("serve_failovers", 1)
         if self._telemetry is not None:
             self._telemetry.flush()
@@ -501,7 +541,8 @@ class ReplicaPool:
                               request_id=c.request_id,
                               first_attempt=att.request_id,
                               hedge_attempt=second.request_id,
-                              age_ms=round((now - c.t_submit) * 1000, 1))
+                              age_ms=round((now - c.t_submit) * 1000, 1),
+                              **_reqtrace.tag(c.trace))
                     log.counter("serve_hedged", 1)
                     log.flush()
 

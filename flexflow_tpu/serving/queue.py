@@ -86,6 +86,10 @@ class InferenceRequest:
         # different replica); ``admitted_by`` is stamped at admission
         self.avoid: Optional[str] = None
         self.admitted_by: Optional[str] = None
+        # request-scoped tracing (observability/reqtrace.TraceContext):
+        # minted once at admission when telemetry is on, None otherwise.
+        # Pool attempts carry a CHILD context of the client's root span.
+        self.trace = None
         self._event = threading.Event()
         self._rlock = threading.RLock()   # guards the resolve CAS
         self._callbacks: List = []

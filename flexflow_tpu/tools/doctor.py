@@ -148,6 +148,65 @@ def _metrics():
     return ", ".join(bits)
 
 
+def _tracing():
+    # Effective request-tracing + SLO env as reqtrace.py/slo.py will
+    # see it — a typo'd sample rate or SLO target raises HERE
+    # (required-style error in the detail), not silently at admission
+    # time — then a synthetic traced request is round-tripped through
+    # tools/timeline_export.py so a broken exporter is a launch-time
+    # finding, not a post-incident one.
+    from ..observability import events, reqtrace, slo
+    from ..tools import timeline_export
+
+    rate = reqtrace.sample_rate_from_env()    # ValueError on garbage
+    chunk = reqtrace.chunk_tokens_from_env()  # ValueError on garbage
+    targets = slo.targets_from_env()          # ValueError on garbage
+    windows = slo.windows_from_env()
+    bits = [f"FF_TRACE_SAMPLE={rate:g}",
+            f"FF_TRACE_CHUNK={chunk or 'off'}"]
+    if rate > 0 and not events._env_enabled():
+        bits.append("WARN: FF_TRACE_SAMPLE set but FF_TELEMETRY off — "
+                    "no log exists, so no trace is ever recorded")
+    if targets:
+        bits.append("SLOs: " + ", ".join(
+            t.name + (f"<{t.threshold_s * 1e3:g}ms"
+                      if t.threshold_s is not None else "")
+            for t in targets)
+            + f" @ {targets[0].objective:g} over "
+            + "/".join(f"{int(w)}s" for w in windows))
+    else:
+        bits.append("SLOs: all disabled")
+
+    # synthetic traced request -> exporter round trip (in-memory log)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        log = events.EventLog(os.path.join(d, "probe.jsonl"))
+        ctx = reqtrace.TraceContext(reqtrace.new_trace_id(),
+                                    reqtrace.new_span_id(), None, True)
+        att = ctx.child()
+        log.span_at("serve_request", 0.0, 0.01, request_id="probe-0",
+                    status="done", **ctx.ids())
+        log.span_at("serve_attempt", 0.001, 0.009,
+                    request_id="probe-0#a1", **att.ids())
+        log.span_at("serve_prefill", 0.002, 0.003,
+                    request_id="probe-0#a1", **reqtrace.tag(att))
+        log.span_at("serve_decode", 0.005, 0.004,
+                    request_id="probe-0#a1", **reqtrace.tag(att))
+        log.close()
+        from .trace_report import parse_trace
+
+        doc = timeline_export.export_records(
+            parse_trace(os.path.join(d, "probe.jsonl")))
+    s = timeline_export.summarize(doc)
+    if s["request_tracks"] < 1 or s["spans"] < 4:
+        raise RuntimeError(
+            f"timeline round trip lost the synthetic request: {s}")
+    bits.append(f"timeline round trip ok ({s['spans']} spans, "
+                f"{s['request_tracks']} request tracks)")
+    return ", ".join(bits)
+
+
 def _memory():
     # The memory & compile plane at a glance: effective FF_MEMPLANE
     # state, whether this backend reports allocator stats at all (TPU:
@@ -470,6 +529,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              ("optional deps", _optional_deps, False),
              ("observability", _observability, False),
              ("metrics", _metrics, False),
+             ("tracing", _tracing, False),
              ("memory", _memory, False),
              ("perf", lambda: _perf(probe=not args.skip_accelerator), False),
              ("search", _search, False),

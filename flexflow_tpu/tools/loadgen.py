@@ -421,6 +421,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "engine": eng_stats,
         "pool": pool_stats,
     }
+    # exemplar traces: the slowest responses' trace ids (present when
+    # the server ran with telemetry) — the join key into the event log,
+    # serve_report's "## Slow requests" waterfall, and timeline_export
+    slow = sorted(((e2e[i], r) for i, r in enumerate(results)
+                   if r is not None and e2e[i] is not None
+                   and r.get("trace_id")), key=lambda x: -x[0])[:3]
+    bench["exemplar_traces"] = [
+        {"trace_id": r["trace_id"], "request_id": r.get("request_id"),
+         "e2e_s": round(t, 6)} for t, r in slow] or None
 
     if args.check_generate:
         import numpy as np
@@ -449,6 +458,12 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"{bench['achieved_tokens_s']:.1f} tok/s · "
           f"occupancy {bench['mean_batch_occupancy']:.2f} -> {args.out}",
           flush=True)
+    if bench["exemplar_traces"]:
+        worst = bench["exemplar_traces"][0]
+        print(f"loadgen: slowest trace {worst['trace_id'][:8]} "
+              f"({worst['e2e_s'] * 1e3:.0f}ms e2e) — grep the telemetry "
+              f"JSONL for the full id or fold it with "
+              f"tools/timeline_export.py", flush=True)
     # sheds are the server protecting itself, not a loadgen failure;
     # anything else unaccounted for is
     failed = (len(ok) + n_shed != len(reqs)

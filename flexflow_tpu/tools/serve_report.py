@@ -11,6 +11,12 @@ and completions plus the pool lifecycle (``replica_down`` /
 ``replica_restart`` / ``request_failover`` / ``request_hedged`` /
 ``request_shed`` / ``pool_drain`` events).
 
+Traced runs (records stamped with ``trace_id`` — any telemetry run
+since reqtrace landed) also get "## Slow requests": the top-5 traces by
+end-to-end latency, each as a queue-wait -> prefill -> decode waterfall
+per attempt, with failover/hedge narration — the markdown twin of the
+Perfetto view ``tools/timeline_export.py`` renders from the same spans.
+
 STDLIB-ONLY, like every report CLI here: a trace from a serving TPU
 must be foldable on any laptop.
 
@@ -57,8 +63,19 @@ def render_report(records: List[Dict[str, Any]],
     window_mix: Dict[int, float] = {}        # decode window -> steps
     _POOL_EVENTS = ("replica_down", "replica_restart", "request_failover",
                     "request_hedged", "request_shed", "pool_drain")
+    _TRACE_SPANS = ("serve_request", "serve_attempt", "serve_queue_wait",
+                    "serve_prefill", "serve_decode", "serve_decode_chunk")
+    trace_spans: Dict[str, List[dict]] = {}   # trace_id -> its spans
+    trace_narr: Dict[str, List[dict]] = {}    # trace_id -> failover/hedge
     for r in records:
         t, name = r.get("t"), r.get("name")
+        tid = (r.get("attrs") or {}).get("trace_id")
+        if tid:
+            if t == "span" and name in _TRACE_SPANS:
+                trace_spans.setdefault(tid, []).append(r)
+            elif t == "event" and name in ("request_failover",
+                                           "request_hedged"):
+                trace_narr.setdefault(tid, []).append(r)
         if t == "meta":
             meta = r
         elif t == "event" and name == "serve_request_done":
@@ -240,6 +257,73 @@ def render_report(records: List[Dict[str, Any]],
                          f"{a.get('inflight', 0)} in flight, "
                          f"{a.get('queued', 0)} queued)")
         lines.append("")
+
+    # ---- slow requests (traced runs) ----------------------------------
+    done_by_trace: Dict[str, List[dict]] = {}
+    for e in done_events:
+        tid = e.get("attrs", {}).get("trace_id")
+        if tid:
+            done_by_trace.setdefault(tid, []).append(e)
+    if done_by_trace:
+        def _e2e(e: dict) -> float:
+            a = e.get("attrs", {})
+            if a.get("ttft_s") is None:   # shed/timed out before a token
+                return float(a.get("queue_wait_s") or 0.0)
+            return (float(a["ttft_s"]) + float(a.get("tpot_s") or 0.0)
+                    * max(0, int(a.get("new_tokens", 1)) - 1))
+
+        ranked = sorted(done_by_trace.items(),
+                        key=lambda kv: -max(_e2e(e) for e in kv[1]))[:5]
+        lines += ["## Slow requests", "",
+                  "Top traces by end-to-end latency.  Sampled requests "
+                  "(FF_TRACE_SAMPLE) carry the full per-attempt "
+                  "waterfall; `tools/timeline_export.py` renders the "
+                  "same spans as a Perfetto timeline.", ""]
+        for rank, (tid, dones) in enumerate(ranked, 1):
+            worst = max(dones, key=_e2e)
+            a = worst.get("attrs", {})
+            rid = str(a.get("request_id", "?")).split("#")[0]
+            statuses = ",".join(sorted({d.get("attrs", {})
+                                        .get("status", "?")
+                                        for d in dones}))
+            lines.append(
+                f"### {rank}. trace `{str(tid)[:8]}` · `{rid}` · "
+                f"{statuses} · {_e2e(worst) * 1e3:.1f} ms "
+                f"({len(dones)} attempt{'s' if len(dones) != 1 else ''})")
+            spans = sorted(trace_spans.get(tid, []),
+                           key=lambda s: float(s.get("ts", 0.0)))
+            if spans:
+                t0 = min(float(s.get("ts", 0.0)) for s in spans)
+                lines += ["", "| attempt | phase | start ms | dur ms |",
+                          "|---|---|---|---|"]
+                for s in spans:
+                    sa = s.get("attrs", {})
+                    srid = str(sa.get("request_id", ""))
+                    att = srid.rsplit("#", 1)[1] if "#" in srid else "-"
+                    ph = str(s.get("name", "?")).replace("serve_", "", 1)
+                    if ph == "decode_chunk":
+                        ph = (f"decode {sa.get('token_from', '?')}-"
+                              f"{sa.get('token_to', '?')}")
+                    lines.append(
+                        f"| {att} | {ph} | "
+                        f"{(float(s.get('ts', 0.0)) - t0) * 1e3:.1f} | "
+                        f"{float(s.get('dur', 0.0)) * 1e3:.1f} |")
+            for ev in sorted(trace_narr.get(tid, []),
+                             key=lambda e: float(e.get("ts", 0.0))):
+                ea = ev.get("attrs", {})
+                ts = float(ev.get("ts", 0.0))
+                if ev.get("name") == "request_failover":
+                    lines.append(
+                        f"- failover off `{ea.get('from_replica', '?')}`"
+                        f" at t={ts:.2f}s -> attempt "
+                        f"`{ea.get('attempt', '?')}` "
+                        f"({ea.get('reason', '?')})")
+                else:
+                    lines.append(
+                        f"- hedged at t={ts:.2f}s after "
+                        f"{ea.get('age_ms', '?')}ms -> attempt "
+                        f"`{ea.get('hedge_attempt', '?')}`")
+            lines.append("")
 
     # ---- failures -----------------------------------------------------
     bad = [e for e in done_events

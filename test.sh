@@ -207,14 +207,19 @@ print(f\"hit rate {b['prefix_hit_rate']}, \"
       f\"{b['prefill_tokens_saved']} prefill tokens saved, \"
       f\"peak {b['kv_blocks_peak']} blocks\")"))"
 
-# Metrics smoke: live /metrics while loadgen drives a 2-replica pool —
-# one mid-load scrape must return serving gauges (per-replica health,
-# paged-KV block occupancy) AND training counters in valid Prometheus
-# text (docs/observability.md "Live metrics endpoint").
+# Metrics + tracing smoke: live /metrics while loadgen drives a
+# 2-replica pool with every request traced (FF_TRACE_SAMPLE=1) — one
+# mid-load scrape must return serving gauges (per-replica health,
+# paged-KV block occupancy), training counters, AND the SLO burn-rate
+# gauges in valid Prometheus text; afterwards the trace must fold into
+# Perfetto-loadable Chrome-trace JSON with request tracks whose attempt
+# spans nest prefill + decode children (docs/observability.md "Live
+# metrics endpoint", "Request tracing", "Timeline export").
 METRICS_PORT=9109
 METRICS_TRACE="$SMOKE_DIR/metrics_serve.jsonl"
 FF_TELEMETRY=1 FF_TELEMETRY_FILE="$METRICS_TRACE" FF_MEMPLANE=1 \
   FF_METRICS_PORT=$METRICS_PORT FF_METRICS_HOST=127.0.0.1 \
+  FF_TRACE_SAMPLE=1 \
   python -m flexflow_tpu.tools.loadgen --requests 24 --concurrency 4 \
     --replicas 2 --seed 0 --train-iters 20 \
     --out "$SMOKE_DIR/BENCH_METRICS.json" > /dev/null &
@@ -227,7 +232,9 @@ want = ("ff_replica_up", "ff_samples_total",   # serving + training series
         "ff_serve_kv_blocks_used", "ff_serve_kv_blocks_free",  # paged KV
         "ff_hbm_bytes",                # KV-pool block bytes (CPU has no
                                        # allocator stats; pool gauge only)
-        "ff_compile_retraces_total")   # compile plane: flat-ladder ledger
+        "ff_compile_retraces_total",   # compile plane: flat-ladder ledger
+        "ff_slo_burn_rate",            # SLO evaluator riding the same tap
+        "ff_slo_budget_remaining")
 sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eE]+$')
 deadline = time.time() + 180
 while time.time() < deadline:
@@ -245,7 +252,11 @@ while time.time() < deadline:
             if line and not line.startswith("#"):
                 assert sample.match(line), f"malformed sample: {line!r}"
                 n += 1
-        print(f"metrics smoke: scraped {n} well-formed samples mid-load")
+        slo = [l for l in text.splitlines()
+               if l.startswith("ff_slo_burn_rate")]
+        assert slo, "no ff_slo_burn_rate sample"
+        print(f"metrics smoke: scraped {n} well-formed samples mid-load "
+              f"({len(slo)} SLO burn-rate series)")
         sys.exit(0)
     time.sleep(0.5)
 sys.exit(f"never saw {want} at {url}")
@@ -253,6 +264,46 @@ EOF
 wait $LOADGEN_PID \
   || { echo "metrics smoke: loadgen exited non-zero"; exit 1; }
 echo "metrics smoke: OK"
+
+# Timeline smoke: fold the traced run into Chrome trace-event JSON.
+TIMELINE="$SMOKE_DIR/timeline.json"
+python -m flexflow_tpu.tools.timeline_export "$METRICS_TRACE" -o "$TIMELINE" \
+  || { echo "timeline smoke: export failed"; exit 1; }
+python - "$TIMELINE" <<'EOF' \
+  || { echo "timeline smoke: Chrome-trace acceptance failed"; exit 1; }
+import collections, json, sys
+doc = json.load(open(sys.argv[1]))
+evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+assert evs, "empty timeline"
+for a, b in zip(evs, evs[1:]):           # Perfetto ground rule 1
+    assert a["ts"] <= b["ts"], (a, b)
+depth = collections.Counter()            # ground rule 2: matched B/E
+for e in evs:
+    k = (e["pid"], e["tid"])
+    if e["ph"] == "B":
+        depth[k] += 1
+    elif e["ph"] == "E":
+        depth[k] -= 1
+        assert depth[k] >= 0, f"E without B on {k}"
+assert all(v == 0 for v in depth.values()), depth
+# >=1 request track whose attempt span nests prefill + decode children
+tracks = doc["otherData"]["request_tracks"]
+assert tracks, "no request tracks despite FF_TRACE_SAMPLE=1"
+procs = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+         if e["ph"] == "M" and e["name"] == "process_name"}
+req_pids = {p for p, n in procs.items() if n == "requests"}
+by_tid = collections.defaultdict(list)
+for e in evs:
+    if e["pid"] in req_pids and e["ph"] == "B":
+        by_tid[e["tid"]].append(e["name"])
+nested = [tid for tid, names in by_tid.items()
+          if names[0] == "serve_attempt"
+          and "serve_prefill" in names and "serve_decode" in names]
+assert nested, f"no attempt track nests prefill+decode: {dict(by_tid)}"
+print(f"timeline smoke: {len(evs)} events, {len(tracks)} request "
+      f"tracks, {len(nested)} attempt tracks with prefill+decode")
+EOF
+echo "timeline smoke: OK"
 
 # Chaos smoke: one seeded FF_CHAOS run injects a NaN step, a mid-epoch
 # SIGTERM, and a failing checkpoint write; the resumed run must finish

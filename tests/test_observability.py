@@ -75,6 +75,46 @@ def test_counters_aggregate(tmp_path):
     assert [r["total"] for r in recs if r["name"] == "samples"] == [32.0, 64.0]
 
 
+def test_broken_observer_detached_once_under_concurrent_emit(
+        tmp_path, capsys):
+    import threading
+
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    healthy = []
+    log.add_observer(healthy.append)
+
+    def boom(rec):
+        raise RuntimeError("observer bug")
+
+    log.add_observer(boom)
+    n_threads, n_recs = 8, 50
+    barrier = threading.Barrier(n_threads)
+
+    def writer(i):
+        barrier.wait()   # all threads hit the broken observer together
+        for j in range(n_recs):
+            log.event("tick", worker=i)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+
+    # exactly one thread won the detach race and warned — not 8, not 400
+    err = capsys.readouterr().err
+    assert err.count("flexflow_tpu: telemetry observer") == 1
+    assert "RuntimeError" in err
+    assert boom not in log._observers
+    # records kept flowing: to the sink AND to the surviving observer
+    ticks = [r for r in _read_jsonl(log.path) if r.get("name") == "tick"]
+    assert len(ticks) == n_threads * n_recs
+    assert sum(r.get("name") == "tick" for r in healthy) \
+        == n_threads * n_recs
+
+
 def test_lazy_open_no_file_without_records(tmp_path):
     log = events.EventLog(str(tmp_path / "t.jsonl"))
     assert not os.path.exists(log.path)  # constructing never touches disk

@@ -1,0 +1,247 @@
+"""End-to-end request tracing (flexflow_tpu/observability/reqtrace.py).
+
+The load-bearing claims: the sampling decision is deterministic in the
+trace id (made once at admission, re-derivable anywhere); a failover
+leaves BOTH attempts as sibling child spans under one trace so the race
+is visible in the timeline; and with telemetry off the tracing plane
+performs zero event-log calls and mints zero contexts.
+"""
+
+import collections
+import json
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.models.transformer import build_transformer
+from flexflow_tpu.observability import events, reqtrace
+from flexflow_tpu.serving.config import ServeConfig
+from flexflow_tpu.serving.engine import InferenceEngine
+from flexflow_tpu.serving.pool import ReplicaPool
+from flexflow_tpu.testing.chaos import ChaosMonkey
+
+V = 32
+MAX_SEQ = 64
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("FF_TELEMETRY", "FF_TELEMETRY_FILE", "FF_TRACE_SAMPLE",
+                "FF_TRACE_CHUNK"):
+        monkeypatch.delenv(var, raising=False)
+    events.reset_active()
+    yield
+    events.reset_active()
+
+
+def _make_model(seed=3):
+    cfg = ff.FFConfig(batch_size=4)
+    m = ff.FFModel(cfg)
+    build_transformer(m, 4, seq_length=MAX_SEQ, num_layers=1,
+                      embed_dim=16, num_heads=2, vocab_size=V)
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=seed)
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _make_model()
+
+
+def _prompts(n, seed=0, lo=3, hi=11):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, V, size=int(rng.integers(lo, hi + 1)))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# unit: ids, sampling, context shape
+# ---------------------------------------------------------------------------
+
+def test_id_shapes():
+    assert len(reqtrace.new_trace_id()) == 32
+    assert len(reqtrace.new_span_id()) == 16
+    int(reqtrace.new_trace_id(), 16)  # hex
+    # run-level id is derived, not random: same run_id -> same trace
+    assert reqtrace.run_trace_id("r1") == reqtrace.run_trace_id("r1")
+    assert reqtrace.run_trace_id("r1") != reqtrace.run_trace_id("r2")
+
+
+def test_sampling_deterministic_and_proportional():
+    tid = reqtrace.new_trace_id()
+    assert not reqtrace.decide(tid, 0.0)
+    assert reqtrace.decide(tid, 1.0)
+    # same id + rate always decides the same way
+    for rate in (0.1, 0.5, 0.9):
+        assert reqtrace.decide(tid, rate) == reqtrace.decide(tid, rate)
+    # over many ids the hit rate tracks the probability (hash quality)
+    ids = [reqtrace.new_trace_id() for _ in range(2000)]
+    hits = sum(reqtrace.decide(t, 0.25) for t in ids)
+    assert 0.18 < hits / len(ids) < 0.32
+    # monotone: an id sampled at rate r stays sampled at every r' > r
+    for t in ids[:100]:
+        if reqtrace.decide(t, 0.25):
+            assert reqtrace.decide(t, 0.5)
+
+
+def test_sample_rate_env_loud(monkeypatch):
+    assert reqtrace.sample_rate_from_env() == 0.0
+    monkeypatch.setenv("FF_TRACE_SAMPLE", "0.25")
+    assert reqtrace.sample_rate_from_env() == 0.25
+    monkeypatch.setenv("FF_TRACE_SAMPLE", "banana")
+    with pytest.raises(ValueError, match="FF_TRACE_SAMPLE"):
+        reqtrace.sample_rate_from_env()
+    monkeypatch.setenv("FF_TRACE_SAMPLE", "1.5")
+    with pytest.raises(ValueError, match="outside"):
+        reqtrace.sample_rate_from_env()
+    monkeypatch.setenv("FF_TRACE_CHUNK", "-1")
+    with pytest.raises(ValueError, match="FF_TRACE_CHUNK"):
+        reqtrace.chunk_tokens_from_env()
+
+
+def test_context_child_and_tags():
+    root = reqtrace.TraceContext("ab" * 16, "cd" * 8, None, True)
+    att = root.child()
+    assert att.trace_id == root.trace_id
+    assert att.parent_span_id == root.span_id
+    assert att.span_id != root.span_id and att.sampled
+    assert reqtrace.tag(None) == {}
+    # unsampled: the 16-byte id only, no span linkage
+    cold = reqtrace.TraceContext("ef" * 16, "01" * 8, None, False)
+    assert reqtrace.tag(cold) == {"trace_id": "ef" * 16}
+    assert reqtrace.tag(att) == {"trace_id": root.trace_id,
+                                 "parent_span_id": att.span_id}
+    assert set(root.ids()) == {"trace_id", "span_id"}
+    assert set(att.ids()) == {"trace_id", "span_id", "parent_span_id"}
+
+
+def test_begin_none_log_is_free():
+    assert reqtrace.begin(None) is None
+
+
+# ---------------------------------------------------------------------------
+# engine: sampled request records join under one trace
+# ---------------------------------------------------------------------------
+
+def test_engine_records_share_trace(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_TRACE_SAMPLE", "1")
+    monkeypatch.setenv("FF_TRACE_CHUNK", "4")
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    with InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                         max_new_tokens=16, telemetry=log) as eng:
+        req = eng.submit(_prompts(1, seed=7)[0], 12)
+        req.result(120)
+        assert req.trace is not None and req.trace.sampled
+    log.close()
+
+    recs = _read_jsonl(log.path)
+    mine = [r for r in recs
+            if (r.get("attrs") or {}).get("trace_id")
+            == req.trace.trace_id]
+    names = collections.Counter(r["name"] for r in mine)
+    assert names["serve_queue_wait"] == 1
+    assert names["serve_prefill"] == 1
+    assert names["serve_decode"] == 1
+    assert names["serve_request_done"] == 1
+    # 12 tokens / chunk 4 -> 3 chunk spans, contiguous token ranges
+    chunks = sorted((r for r in mine if r["name"] == "serve_decode_chunk"),
+                    key=lambda r: r["attrs"]["token_from"])
+    assert len(chunks) == 3
+    for a, b in zip(chunks, chunks[1:]):
+        assert a["attrs"]["token_to"] == b["attrs"]["token_from"]
+    # sub-records parent to the request's own span
+    for r in mine:
+        assert r["attrs"]["parent_span_id"] == req.trace.span_id
+
+
+def test_unsampled_request_id_only(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_TRACE_SAMPLE", "0")
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    with InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                         max_new_tokens=16, telemetry=log) as eng:
+        req = eng.submit(_prompts(1, seed=8)[0], 6)
+        req.result(120)
+        assert req.trace is not None and not req.trace.sampled
+    log.close()
+    recs = _read_jsonl(log.path)
+    mine = [r for r in recs
+            if (r.get("attrs") or {}).get("trace_id")
+            == req.trace.trace_id]
+    # records still join on the id, but carry no span linkage and no
+    # chunk spans / KV events rode along
+    assert {r["name"] for r in mine} <= {
+        "serve_queue_wait", "serve_prefill", "serve_decode",
+        "serve_request_done"}
+    assert all("parent_span_id" not in r["attrs"] for r in mine)
+
+
+# ---------------------------------------------------------------------------
+# pool: failover leaves sibling attempt spans under one trace
+# ---------------------------------------------------------------------------
+
+def test_failover_attempts_are_siblings(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_TRACE_SAMPLE", "1")
+    monkeypatch.setattr(model, "_chaos",
+                        ChaosMonkey("serve:3=replica_kill"))
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    cfg = ServeConfig(max_batch=2, max_seq=MAX_SEQ, replicas=3,
+                      replica_timeout_s=120.0, restart_backoff_s=0.05,
+                      restart_cap_s=0.2)
+    prompts = _prompts(8, seed=2)
+    with ReplicaPool(model, config=cfg, telemetry=log) as pool:
+        handles = [pool.submit(p, 8) for p in prompts]
+        outs = [h.result(120) for h in handles]
+        st = pool.stats()
+    log.close()
+    for p, got in zip(prompts, outs):
+        assert np.array_equal(got, model.generate(p[None], 8)[0])
+    assert st["failovers"] >= 1, "the kill never caught a request"
+
+    recs = _read_jsonl(log.path)
+    fo = [r for r in recs if r.get("name") == "request_failover"]
+    assert fo and all(r["attrs"].get("trace_id") for r in fo)
+    tid = fo[0]["attrs"]["trace_id"]
+    mine = [r for r in recs
+            if (r.get("attrs") or {}).get("trace_id") == tid]
+    roots = [r for r in mine if r["name"] == "serve_request"]
+    atts = [r for r in mine if r["name"] == "serve_attempt"]
+    assert len(roots) == 1
+    assert len(atts) >= 2, "failover must leave both attempt spans"
+    root_span = roots[0]["attrs"]["span_id"]
+    # every attempt is a CHILD of the client root -> siblings
+    for a in atts:
+        assert a["attrs"]["parent_span_id"] == root_span
+        assert "#a" in a["attrs"]["request_id"]
+    # attempt incarnations differ (the race is visible)
+    assert len({a["attrs"]["incarnation"] for a in atts}) >= 2
+    # the root span covers its attempts (same submit clock)
+    t0 = roots[0]["ts"]
+    t1 = t0 + roots[0]["dur"]
+    for a in atts:
+        assert a["ts"] >= t0 - 1e-6
+        assert a["ts"] + a["dur"] <= t1 + 0.05
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when disabled
+# ---------------------------------------------------------------------------
+
+def test_disabled_zero_log_calls(model, monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        events.EventLog, "_write",
+        lambda self, rec: calls.append(rec))
+    with InferenceEngine(model, max_batch=2, max_seq=MAX_SEQ,
+                         max_new_tokens=8) as eng:   # telemetry=None
+        req = eng.submit(_prompts(1, seed=9)[0], 4)
+        req.result(120)
+    assert req.trace is None          # no context was ever minted
+    assert calls == []                # and no record was ever written

@@ -1,0 +1,233 @@
+"""SLO burn-rate evaluation (flexflow_tpu/observability/slo.py).
+
+Burn rates are checked against hand-computed windows (the evaluator's
+clock is the record timestamp, so the arithmetic is exact), alerting is
+checked for hysteresis (one firing per episode, cleared only at half
+the threshold), and the metrics wiring is checked end to end: a
+serve_request_done stream through a real EventLog must surface as
+``ff_slo_burn_rate{slo,window}`` in a Prometheus scrape.
+"""
+
+import urllib.request
+
+import pytest
+
+from flexflow_tpu.observability import events, metrics, slo
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    for var in ("FF_TELEMETRY", "FF_TELEMETRY_FILE", "FF_METRICS_PORT",
+                "FF_METRICS_HOST", "FF_SLO_TTFT_MS", "FF_SLO_TPOT_MS",
+                "FF_SLO_QUEUE_WAIT_MS", "FF_SLO_AVAILABILITY",
+                "FF_SLO_OBJECTIVE", "FF_SLO_WINDOWS",
+                "FF_SLO_BURN_ALERT"):
+        monkeypatch.delenv(var, raising=False)
+    events.reset_active()
+    metrics.stop()      # also resets slo's attach list
+    yield
+    metrics.stop()
+    events.reset_active()
+
+
+class _FakeLog:
+    """Capture the evaluator's emissions without a real sink."""
+
+    def __init__(self):
+        self.gauges = []    # (name, value, attrs)
+        self.events = []    # (name, attrs)
+
+    def gauge(self, name, v, **attrs):
+        self.gauges.append((name, v, attrs))
+
+    def event(self, name, **attrs):
+        self.events.append((name, attrs))
+
+    def add_observer(self, fn):
+        pass
+
+
+def _done(ts, **attrs):
+    attrs.setdefault("status", "done")
+    return {"t": "event", "name": "serve_request_done", "ts": ts,
+            "attrs": attrs}
+
+
+# ---------------------------------------------------------------------------
+# burn-rate arithmetic vs hand-computed windows
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_matches_hand_computation():
+    log = _FakeLog()
+    target = slo.SLOTarget("ttft", "ttft_s", 0.1, objective=0.9)
+    ev = slo.BurnRateEvaluator(log, targets=[target], windows=(2.0, 4.0),
+                               burn_alert=100.0)   # alerts out of the way
+    for ts, ttft in ((0.0, 0.05), (1.0, 0.2), (2.0, 0.05), (3.0, 0.05)):
+        ev.observe(_done(ts, ttft_s=ttft))
+    last = {(a["slo"], a["window"]): v
+            for n, v, a in log.gauges if n == "slo_burn_rate"}
+    # at ts=3, window 2 covers ts in [1, 3]: bad 1/3 -> /(1-0.9) = 3.3333
+    assert last[("ttft", "2")] == pytest.approx(3.3333, abs=1e-4)
+    # window 4 covers all four: bad 1/4 -> 2.5
+    assert last[("ttft", "4")] == pytest.approx(2.5)
+    # budget over the LONG window: 1 - 2.5, floored at 0
+    budget = [v for n, v, a in log.gauges
+              if n == "slo_budget_remaining" and a["slo"] == "ttft"]
+    assert budget[-1] == 0.0
+    # a request with the latency field missing (shed/timeout) is BAD
+    ev.observe(_done(3.5, status="timeout"))
+    last = {(a["slo"], a["window"]): v
+            for n, v, a in log.gauges if n == "slo_burn_rate"}
+    # window 2 now covers ts in [1.5, 3.5]: bads = missing-field one -> 1/3
+    assert last[("ttft", "2")] == pytest.approx(3.3333, abs=1e-4)
+
+
+def test_availability_counts_status():
+    log = _FakeLog()
+    target = slo.SLOTarget("availability", None, None, objective=0.5)
+    ev = slo.BurnRateEvaluator(log, targets=[target], windows=(10.0,),
+                               burn_alert=100.0)
+    for ts, st in ((0.0, "done"), (1.0, "error"), (2.0, "done"),
+                   (3.0, "done")):
+        ev.observe(_done(ts, status=st))
+    last = [v for n, v, a in log.gauges if n == "slo_burn_rate"][-1]
+    # bad 1/4 over (1 - 0.5) -> 0.5
+    assert last == pytest.approx(0.5)
+
+
+def test_samples_evicted_past_longest_window():
+    log = _FakeLog()
+    target = slo.SLOTarget("availability", None, None, objective=0.9)
+    ev = slo.BurnRateEvaluator(log, targets=[target], windows=(2.0, 4.0),
+                               burn_alert=100.0)
+    ev.observe(_done(0.0, status="error"))
+    for ts in (5.0, 6.0, 7.0):
+        ev.observe(_done(ts))
+    # the ts=0 failure fell out of even the long window -> burn 0
+    last = {a["window"]: v
+            for n, v, a in log.gauges if n == "slo_burn_rate"}
+    assert last["2"] == 0.0 and last["4"] == 0.0
+    assert len(ev._samples) == 3
+
+
+# ---------------------------------------------------------------------------
+# alert hysteresis: one firing per episode, clear at half threshold
+# ---------------------------------------------------------------------------
+
+def test_alert_fires_once_and_clears_with_hysteresis():
+    log = _FakeLog()
+    target = slo.SLOTarget("availability", None, None, objective=0.9)
+    ev = slo.BurnRateEvaluator(log, targets=[target], windows=(2.0, 4.0),
+                               burn_alert=2.0)
+    for ts in range(5):                       # sustained outage
+        ev.observe(_done(float(ts), status="error"))
+    firing = [a for n, a in log.events if n == "slo_alert"]
+    assert len(firing) == 1, "alert must fire once per episode"
+    assert firing[0]["state"] == "firing"
+    assert firing[0]["slo"] == "availability"
+    assert firing[0]["burn_2s"] == pytest.approx(10.0)
+    for ts in range(5, 21):                   # recovery
+        ev.observe(_done(float(ts)))
+    states = [a["state"] for n, a in log.events if n == "slo_alert"]
+    assert states == ["firing", "cleared"]
+    # cleared only once burn < threshold/2 on EVERY window — while the
+    # long window still held a failure the alert stayed up
+    cleared = [a for n, a in log.events if a["state"] == "cleared"][0]
+    assert cleared["burn_2s"] < 1.0 and cleared["burn_4s"] < 1.0
+
+
+def test_alert_needs_all_windows():
+    # a 1-sample blip drives the SHORT window way up but not the long
+    # one -> no alert (the multi-window guard)
+    log = _FakeLog()
+    target = slo.SLOTarget("availability", None, None, objective=0.9)
+    ev = slo.BurnRateEvaluator(log, targets=[target], windows=(1.0, 60.0),
+                               burn_alert=2.0)
+    for ts in range(50):
+        ev.observe(_done(float(ts)))
+    ev.observe(_done(50.0, status="error"))   # short window: burn 10
+    assert [n for n, _ in log.events if n == "slo_alert"] == []
+
+
+# ---------------------------------------------------------------------------
+# env parsing (loud) + declarative defaults
+# ---------------------------------------------------------------------------
+
+def test_targets_from_env_defaults_and_disable(monkeypatch):
+    names = [t.name for t in slo.targets_from_env()]
+    assert names == ["ttft", "tpot", "queue_wait", "availability"]
+    monkeypatch.setenv("FF_SLO_TTFT_MS", "0")
+    monkeypatch.setenv("FF_SLO_AVAILABILITY", "0")
+    names = [t.name for t in slo.targets_from_env()]
+    assert names == ["tpot", "queue_wait"]
+    monkeypatch.setenv("FF_SLO_TPOT_MS", "250")
+    tpot = slo.targets_from_env()[0]
+    assert tpot.threshold_s == pytest.approx(0.25)
+
+
+def test_env_parsing_is_loud(monkeypatch):
+    monkeypatch.setenv("FF_SLO_TTFT_MS", "fast")
+    with pytest.raises(ValueError, match="FF_SLO_TTFT_MS"):
+        slo.targets_from_env()
+    monkeypatch.delenv("FF_SLO_TTFT_MS")
+    monkeypatch.setenv("FF_SLO_OBJECTIVE", "1.5")
+    with pytest.raises(ValueError, match="FF_SLO_OBJECTIVE"):
+        slo.targets_from_env()
+    monkeypatch.delenv("FF_SLO_OBJECTIVE")
+    monkeypatch.setenv("FF_SLO_WINDOWS", "60,banana")
+    with pytest.raises(ValueError, match="FF_SLO_WINDOWS"):
+        slo.windows_from_env()
+    monkeypatch.setenv("FF_SLO_WINDOWS", "-5")
+    with pytest.raises(ValueError, match="positive"):
+        slo.windows_from_env()
+    monkeypatch.setenv("FF_SLO_WINDOWS", "300,60")
+    assert slo.windows_from_env() == (60.0, 300.0)   # sorted
+
+
+# ---------------------------------------------------------------------------
+# wiring: maybe_attach + the metrics plane
+# ---------------------------------------------------------------------------
+
+def test_maybe_attach_gates_and_idempotence(tmp_path, monkeypatch):
+    assert slo.maybe_attach(None) is None          # telemetry off
+    for var in ("FF_SLO_TTFT_MS", "FF_SLO_TPOT_MS",
+                "FF_SLO_QUEUE_WAIT_MS", "FF_SLO_AVAILABILITY"):
+        monkeypatch.setenv(var, "0")
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    assert slo.maybe_attach(log) is None           # every SLO disabled
+    for var in ("FF_SLO_TTFT_MS", "FF_SLO_TPOT_MS",
+                "FF_SLO_QUEUE_WAIT_MS", "FF_SLO_AVAILABILITY"):
+        monkeypatch.delenv(var)
+    ev = slo.maybe_attach(log)
+    assert ev is not None
+    assert slo.maybe_attach(log) is ev             # idempotent per log
+    assert len(log._observers) == 1
+    log.close()
+
+
+def test_scrape_carries_slo_series(tmp_path, monkeypatch):
+    monkeypatch.setenv("FF_METRICS_PORT", "0")
+    monkeypatch.setenv("FF_METRICS_HOST", "127.0.0.1")
+    log = events.EventLog(str(tmp_path / "t.jsonl"))
+    reg = metrics.maybe_start(log)
+    assert reg is not None
+    # a flash crowd: every request blows the TTFT target
+    for _ in range(6):
+        log.event("serve_request_done", status="done", ttft_s=9.0,
+                  tpot_s=0.001, queue_wait_s=0.001)
+    port = metrics.server_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert 'ff_slo_burn_rate{slo="ttft",window="60"}' in text
+    assert 'ff_slo_budget_remaining{slo="ttft"}' in text
+    # ttft burn is pinned at 100x (all bad, objective 0.99)
+    line = [l for l in text.splitlines()
+            if l.startswith('ff_slo_burn_rate{slo="ttft",window="60"}')][0]
+    assert float(line.split()[-1]) == pytest.approx(100.0)
+    # the healthy SLOs burn 0 and the alert fired for ttft only
+    line = [l for l in text.splitlines()
+            if l.startswith('ff_slo_burn_rate{slo="tpot",window="60"}')][0]
+    assert float(line.split()[-1]) == 0.0
+    assert 'ff_events_total{event="slo_alert"} 1' in text
+    log.close()
