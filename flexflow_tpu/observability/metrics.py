@@ -55,7 +55,7 @@ from . import events
 # dropped from the label set (NOT from the trace) to bound cardinality
 LABEL_KEYS = ("device", "event", "kind", "op", "outcome", "phase", "reason",
               "replica", "scope", "site", "slo", "src", "status", "which",
-              "window")
+              "window", "zone")
 
 # histogram quantiles exposed on every summary series
 QUANTILES = (50.0, 95.0, 99.0)
@@ -336,8 +336,11 @@ def render_backend(backend) -> str:
             ups, incs, rsts = [], [], []
             for r in hz.get("replicas", []):
                 name = str(r.get("name"))
-                lab = _label_str((("replica", name),
-                                  ("state", str(r.get("state")))))
+                pairs = [("replica", name),
+                         ("state", str(r.get("state")))]
+                if r.get("zone") is not None:
+                    pairs.append(("zone", str(r["zone"])))
+                lab = _label_str(tuple(sorted(pairs)))
                 ups.append(f"ff_replica_up{lab} "
                            f"{1 if r.get('state') == 'ready' else 0}")
                 inc = r.get("incarnation")
@@ -357,6 +360,18 @@ def render_backend(backend) -> str:
             if rsts:
                 out.append("# TYPE ff_replica_restarts gauge")
                 out.extend(rsts)
+            zones = hz.get("zones") or {}
+            if zones:
+                out.append("# TYPE ff_zone_ready_replicas gauge")
+                for z, zd in zones.items():
+                    out.append("ff_zone_ready_replicas%s %d" % (
+                        _label_str((("zone", str(z)),)),
+                        int(zd.get("ready", 0))))
+                out.append("# TYPE ff_zone_down gauge")
+                for z, zd in zones.items():
+                    out.append("ff_zone_down%s %d" % (
+                        _label_str((("zone", str(z)),)),
+                        1 if zd.get("down") else 0))
             # fold paged-KV occupancy across live replica engines
             kvs = [r["engine"]["kv"]
                    for r in backend.stats().get("replicas", {}).values()

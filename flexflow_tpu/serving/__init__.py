@@ -11,7 +11,11 @@ Layers:
                 the continuous-batching decode loop (imports jax)
 * ``pool``    — ``ReplicaPool``: N health-checked engine replicas
                 behind one admission queue — failover, load shedding,
-                hedging, graceful drain (imports jax via engine)
+                hedging, zones, elastic membership, graceful drain
+                (imports jax via engine)
+* ``autoscaler`` — ``Autoscaler``/``ScaleConfig``: metrics-driven
+                add/drain of pool replicas within FF_SCALE_MIN/MAX
+                (stdlib-only policy)
 * ``api``     — ``ServingAPI``: stdlib ThreadingHTTPServer front end
                 (backend: an engine or a pool)
 
@@ -19,13 +23,16 @@ Layers:
 (doctor, report CLIs) can read the config layer without touching jax.
 """
 
+from .autoscaler import Autoscaler, ScaleConfig
 from .config import ServeConfig
 from .kvpool import BlockExhausted, KVBlockPool
 from .queue import (InferenceRequest, RequestQueue, ServeError,
                     ServeOverload, ServeTimeout)
 
-__all__ = ["BlockExhausted", "InferenceEngine", "InferenceRequest",
-           "KVBlockPool", "ReplicaPool", "RequestQueue", "ServeConfig",
+__all__ = ["Autoscaler", "BlockExhausted", "InferenceEngine",
+           "InferenceRequest",
+           "KVBlockPool", "ReplicaPool", "RequestQueue", "ScaleConfig",
+           "ServeConfig",
            "ServeError", "ServeOverload", "ServeTimeout", "ServingAPI"]
 
 

@@ -62,6 +62,14 @@ Replica-pool knobs (serving/pool.py; all inert for a bare engine):
   FF_SERVE_RESTART_BACKOFF_S restart_backoff_s  base of the bounded
                                               exponential restart backoff
   FF_SERVE_RESTART_CAP_S   restart_cap_s      backoff ceiling
+  FF_SERVE_ZONES           zones              comma list of failure-domain
+                                              names, e.g. "zone-a,zone-b";
+                                              replicas are placed round-robin
+                                              across them and hedges/failovers
+                                              prefer a DIFFERENT zone (empty:
+                                              zone-unaware, today's behavior)
+
+Autoscaler knobs (FF_SCALE_*) live in serving/autoscaler.py.
 """
 
 from __future__ import annotations
@@ -122,6 +130,7 @@ class ServeConfig:
     hedge_ms: float = 0.0              # 0: hedging off
     restart_backoff_s: float = 0.5
     restart_cap_s: float = 30.0
+    zones: Tuple[str, ...] = ()        # (): zone-unaware placement
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -164,6 +173,13 @@ class ServeConfig:
             if getattr(self, name) < 0:
                 raise ValueError(f"{name} must be >= 0, "
                                  f"got {getattr(self, name)}")
+        self.zones = tuple(self.zones)
+        if any(not z or not str(z).strip() for z in self.zones):
+            raise ValueError(
+                f"FF_SERVE_ZONES names must be non-empty: {self.zones}")
+        if len(set(self.zones)) != len(self.zones):
+            raise ValueError(
+                f"FF_SERVE_ZONES names must be unique: {self.zones}")
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -199,6 +215,14 @@ class ServeConfig:
             except ValueError:
                 raise ValueError(f"FF_SERVE_BUCKETS={raw!r}: expected "
                                  "comma-separated integers")
+        raw = os.environ.get("FF_SERVE_ZONES", "")
+        if raw:
+            zones = tuple(p.strip() for p in raw.split(","))
+            if any(not z for z in zones):
+                raise ValueError(
+                    f"FF_SERVE_ZONES={raw!r}: expected a comma list of "
+                    "non-empty zone names")
+            kw["zones"] = zones
         kw.update(overrides)
         return cls(**kw)
 
@@ -266,6 +290,8 @@ class ServeConfig:
                     f"hedge={self.hedge_ms:g}ms "
                     f"restart_backoff={self.restart_backoff_s:g}s"
                     f"/{self.restart_cap_s:g}s")
+        if self.zones:
+            pool += f" zones={list(self.zones)}"
         kv = ""
         if self.paged != "off":
             kv = (f" paged={self.paged} kv_block={self.kv_block} "

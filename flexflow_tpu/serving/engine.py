@@ -74,6 +74,12 @@ from .queue import (CANCELLED, DONE, ERROR, RUNNING, TIMEOUT,
 
 _engine_uids = itertools.count(1)
 
+# cancel reason an ABANDONED engine stamps on slots it still held at
+# exit: the pool recognizes it in _on_attempt_done and re-dispatches
+# (the attempt was popped after the failover snapshot — handing it
+# back is the only exactly-once option left)
+ABANDON_HANDBACK = "engine abandoned"
+
 
 class _Slot:
     """Host-side state of one running sequence."""
@@ -110,7 +116,7 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[ServeConfig] = None,
                  telemetry=None, queue: Optional[RequestQueue] = None,
                  name: Optional[str] = None, decode_fatal: bool = False,
-                 **overrides):
+                 zone: Optional[str] = None, **overrides):
         assert getattr(model, "_compiled", False), \
             "InferenceEngine needs a compiled model (call compile() first)"
         self.model = model
@@ -129,6 +135,14 @@ class InferenceEngine:
         #    requests over) instead of failing the batch in place.
         self.name = name or "replica-0"
         self.uid = f"{self.name}#{next(_engine_uids)}"
+        # zone = failure-domain label.  The pop avoid-key set includes
+        # "zone:<z>" so a hedge/failover marked to avoid a whole zone is
+        # never popped back by ANY replica in it; telemetry carries the
+        # zone for per-zone occupancy in serve_report.
+        self.zone = zone
+        self._avoid_keys = (self.uid,) if zone is None \
+            else (self.uid, f"zone:{zone}")
+        self._zone_attr = {} if zone is None else {"zone": zone}
         self._decode_fatal = bool(decode_fatal)
         self.crashed: Optional[str] = None   # set when the loop dies
         self.last_beat = time.perf_counter()  # decode-progress heartbeat
@@ -201,6 +215,8 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._drain = True
+        self._retiring = False   # graceful single-replica drain (pool)
+        self._abandoned = False  # pool detached us; it owns our in-flight
         # submits are accepted from construction (queueing before
         # start() is legal — the loop admits once it runs); only stop()
         # closes the door
@@ -396,13 +412,35 @@ class InferenceEngine:
             t.join(timeout)
             self._thread = None
 
+    def retire(self, timeout: float = 60.0) -> None:
+        """Graceful single-replica drain for a SHARED-queue pool member:
+        stop popping NEW work (other replicas keep serving the shared
+        queue), finish the decode slots already live plus any parked
+        admission, then exit.  ``stop(drain=True)`` is the wrong tool
+        here — its exit condition waits for the WHOLE shared queue to
+        empty, which under sustained load never happens."""
+        self._accepting = False
+        self._retiring = True
+        self._drain = True
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if not t.is_alive():
+                self._thread = None
+
     def abandon(self) -> None:
         """Pool-side: detach this (crashed or wedged) incarnation
         WITHOUT joining its thread — a thread sleeping inside an
         injected hang may not wake for an hour, and it is a daemon.
         The loop exits at its next conscious moment; any request it
         still resolves afterwards loses the CAS against the pool's
-        failover and is ignored."""
+        failover and is ignored.  The exiting loop must NOT cancel its
+        slots either (``_abandoned`` gates the shutdown cancellation):
+        a HEALTHY engine abandoned by a zone outage would otherwise
+        race its "engine stopped" cancel against the pool's failover
+        untracking — and win, failing the client."""
+        self._abandoned = True
         self._accepting = False
         self._drain = False
         self._stop_evt.set()
@@ -532,7 +570,12 @@ class InferenceEngine:
             if self._stop_evt.is_set():
                 if not self._drain:
                     break
-                if self.num_active == 0 and len(self._queue) == 0 \
+                if self._retiring:
+                    # retiring pool member: own slots empty is enough —
+                    # the shared queue belongs to the surviving replicas
+                    if self.num_active == 0 and self._pending_admit is None:
+                        break
+                elif self.num_active == 0 and len(self._queue) == 0 \
                         and self._pending_admit is None:
                     break
             self._admit_ready(now)
@@ -549,6 +592,26 @@ class InferenceEngine:
         # shutdown: a standalone engine owns its queue and cancels what
         # is left; a pool replica must NOT drain the shared queue (other
         # replicas' requests live there) — the pool drains it once
+        if self._abandoned:
+            # the pool detached this incarnation (failover/zone outage):
+            # it untracks and re-dispatches the slots it SNAPSHOTTED, so
+            # for those our cancel must lose the CAS — and it does, the
+            # pool force-cancels them first.  But anything we popped in
+            # the window between its snapshot and our exit is still
+            # tracked: cancel with the ABANDON_HANDBACK marker so the
+            # pool re-dispatches it instead of failing the client.
+            parked, self._pending_admit = self._pending_admit, None
+            if parked is not None \
+                    and parked._resolve(CANCELLED, ABANDON_HANDBACK):
+                self._stats["cancelled"] += 1
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    if slot.res is not None:
+                        self._kvpool.release(slot.res)
+                    if slot.req._resolve(CANCELLED, ABANDON_HANDBACK):
+                        self._stats["cancelled"] += 1
+                    self._slots[i] = None
+            return
         if self._owns_queue:
             self._stats["cancelled"] += self._queue.drain(
                 CANCELLED, "engine stopped")
@@ -585,7 +648,9 @@ class InferenceEngine:
                         self._emit_done(req)
                     continue
             else:
-                req = self._queue.pop_ready(now, avoid_key=self.uid)
+                if self._retiring or self._abandoned:
+                    return      # no NEW pops: draining, or detached
+                req = self._queue.pop_ready(now, avoid_key=self._avoid_keys)
             if req is None:
                 return
             self._admitting = req
@@ -801,7 +866,7 @@ class InferenceEngine:
         self._stats["occupancy_sum"] += active
         if self._telemetry is not None:
             self._telemetry.gauge("serve_batch_occupancy", active,
-                                  replica=self.name)
+                                  replica=self.name, **self._zone_attr)
             if self._paged:
                 st = self._kvpool.stats()
                 self._telemetry.gauge("serve_kv_blocks_used",
@@ -887,7 +952,8 @@ class InferenceEngine:
                         **tr)
         attrs = dict(request_id=req.request_id, status=req.status,
                      prompt_len=int(req.prompt.size),
-                     new_tokens=len(req.tokens), replica=self.name, **tr)
+                     new_tokens=len(req.tokens), replica=self.name,
+                     **self._zone_attr, **tr)
         for k in ("queue_wait_s", "ttft_s", "tpot_s"):
             v = getattr(req, k)
             if v is not None:

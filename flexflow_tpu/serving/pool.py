@@ -66,10 +66,28 @@ admitted or queued, shed nothing mid-flight, ``pool_drain`` event).
 Losing replicas degrades THROUGHPUT only: greedy outputs are bitwise
 ``FFModel.generate()`` regardless of which replica, restart, or
 failover served them, because every attempt prefills from scratch.
+
+Zones (``FF_SERVE_ZONES``): replicas are placed round-robin across the
+named failure domains and carry the zone as a telemetry label.  Hedges
+and zone-outage failovers avoid the FIRST attempt's whole zone (the
+``avoid`` key set grows ``"zone:<z>"``), so correlated failures — the
+``serve:...=zone_outage[:zone]`` chaos fault marks every replica of a
+zone down at once — strand nothing: the monitor fails all in-flight
+attempts over exactly-once (same CAS model) and replicas in a down
+zone are NOT restarted in place; capacity comes back via
+``add_replica`` in surviving zones (the autoscaler's backfill).
+
+Elastic membership (serving/autoscaler.py drives these, but they are
+plain pool API): ``add_replica`` spawns a fresh replica;
+``drain_replica`` gracefully retires one — it stops popping new work,
+finishes its in-flight slots, then the incarnation is REMOVED from the
+replica list so ``healthz``/``ff_replica_up`` never report a dead
+series forever.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -77,14 +95,15 @@ from typing import Any, Dict, List, Optional, Sequence
 from ..observability import reqtrace as _reqtrace
 from ..runtime.resilience import backoff_delay
 from .config import ServeConfig
-from .engine import InferenceEngine
+from .engine import ABANDON_HANDBACK, InferenceEngine
 from .queue import (CANCELLED, DONE, InferenceRequest, RequestQueue,
                     ServeError, ServeOverload)
 
 import numpy as np
 
 # replica states
-READY, RESTARTING, STOPPED = "ready", "restarting", "stopped"
+READY, RESTARTING, STOPPED, DRAINING = (
+    "ready", "restarting", "stopped", "draining")
 
 
 class _Replica:
@@ -92,11 +111,12 @@ class _Replica:
     and its restart bookkeeping."""
 
     __slots__ = ("name", "model", "engine", "state", "fails", "restarts",
-                 "restart_at", "failovers")
+                 "restart_at", "failovers", "zone")
 
-    def __init__(self, name: str, model):
+    def __init__(self, name: str, model, zone: Optional[str] = None):
         self.name = name
         self.model = model
+        self.zone = zone
         self.engine: Optional[InferenceEngine] = None
         self.state = STOPPED
         self.fails = 0           # consecutive down-marks (backoff input)
@@ -147,8 +167,19 @@ class ReplicaPool:
             else getattr(model_list[0], "_telemetry", None)
 
         self._queue = RequestQueue()
-        self._replicas = [_Replica(f"replica-{i}", m)
-                          for i, m in enumerate(model_list)]
+        zones = self.config.zones
+        self._replicas = [
+            _Replica(f"replica-{i}", m,
+                     zone=zones[i % len(zones)] if zones else None)
+            for i, m in enumerate(model_list)]
+        # models to hand to replicas added later (round-robin over the
+        # DISTINCT models the caller gave us; on CPU they share one
+        # compiled model, on hardware one per device slice)
+        self._model_pool = list(models) if isinstance(models, (list, tuple)) \
+            else [models]
+        self._replica_seq = itertools.count(len(model_list))
+        self._zones_down: set = set()   # chaos-marked failure domains
+        self._chaos = getattr(model_list[0], "_chaos", None)
         self._lock = threading.RLock()
         self._clients: Dict[str, _Client] = {}    # client id -> state
         self._attempts: Dict[str, _Client] = {}   # attempt id -> state
@@ -158,9 +189,12 @@ class ReplicaPool:
         self._monitor_thread: Optional[threading.Thread] = None
         self._preemption = None
         self._svc_ewma: Optional[float] = None   # submit->done seconds
+        self._last_ready_gauge: Optional[int] = None
         self._stats = dict(submitted=0, shed=0, hedged=0, failovers=0,
                            completed=0, failed=0, timeouts=0, cancelled=0,
-                           replica_downs=0, replica_restarts=0)
+                           replica_downs=0, replica_restarts=0,
+                           replicas_added=0, replicas_retired=0,
+                           zone_outages=0)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -169,6 +203,9 @@ class ReplicaPool:
         assert self._monitor_thread is None, "pool already started"
         for rep in self._replicas:
             self._spawn_engine(rep)
+        # standalone expiry sweeper: keeps queue-wait deadlines honest
+        # even while every engine is draining (nothing puts or pops)
+        self._queue.start_sweeper()
         self._accepting = True
         self._stop_evt.clear()
         self._monitor_thread = threading.Thread(
@@ -186,7 +223,7 @@ class ReplicaPool:
             with self._lock:
                 self._accepting = False
                 self._draining = True
-            for rep in self._replicas:
+            for rep in list(self._replicas):
                 if rep.engine is not None and rep.state == READY:
                     rep.engine.stop(drain=False)
                 rep.state = STOPPED
@@ -197,6 +234,7 @@ class ReplicaPool:
         if t is not None:
             t.join(timeout)
             self._monitor_thread = None
+        self._queue.stop_sweeper()
 
     def __enter__(self) -> "ReplicaPool":
         return self.start()
@@ -214,9 +252,106 @@ class ReplicaPool:
     def _spawn_engine(self, rep: _Replica) -> None:
         rep.engine = InferenceEngine(
             rep.model, config=self.config, telemetry=self._telemetry,
-            queue=self._queue, name=rep.name, decode_fatal=True)
+            queue=self._queue, name=rep.name, decode_fatal=True,
+            zone=rep.zone)
         rep.engine.start()
         rep.state = READY
+
+    # ------------------------------------------------------------------
+    # elastic membership (the autoscaler's levers; plain pool API)
+    # ------------------------------------------------------------------
+    def add_replica(self, zone: Optional[str] = None) -> Optional[str]:
+        """Scale up: spawn one fresh replica and return its name.
+        ``zone=None`` auto-places in the least-populated zone that is
+        not chaos-marked down (the surviving-zone backfill path).
+        Returns None while the pool is not accepting (drain/stop)."""
+        with self._lock:
+            if not self._accepting or self._draining:
+                return None
+            idx = next(self._replica_seq)
+            z = zone if zone is not None else self._pick_zone()
+            model = self._model_pool[idx % len(self._model_pool)]
+            rep = _Replica(f"replica-{idx}", model, zone=z)
+            self._replicas.append(rep)
+        try:
+            self._spawn_engine(rep)
+        except Exception as e:  # noqa: BLE001 — surface, don't die
+            with self._lock:
+                if rep in self._replicas:
+                    self._replicas.remove(rep)
+            if self._telemetry is not None:
+                self._telemetry.event(
+                    "replica_add_failed", replica=rep.name,
+                    error=f"{type(e).__name__}: {e}")
+                self._telemetry.flush()
+            return None
+        with self._lock:
+            self._stats["replicas_added"] += 1
+        log = self._telemetry
+        if log is not None:
+            attrs = dict(replica=rep.name, incarnation=rep.engine.uid)
+            if z is not None:
+                attrs["zone"] = z
+            log.event("replica_added", **attrs)
+            log.flush()
+        return rep.name
+
+    def drain_replica(self, name: Optional[str] = None,
+                      timeout: float = 60.0) -> Optional[str]:
+        """Scale down, gracefully: pick a READY victim (``name``, or the
+        newest replica in the most-populated zone), stop admitting to
+        it, let its in-flight slots finish, then RETIRE the incarnation
+        — it disappears from ``healthz``/``ff_replica_up`` so scrapes
+        never report a dead series.  A victim that wedges mid-drain is
+        abandoned and its work failed over like a crash.  Returns the
+        retired name, or None when nothing is drainable."""
+        with self._lock:
+            if self._draining:
+                return None
+            ready = [r for r in self._replicas if r.state == READY]
+            if name is not None:
+                victim = next((r for r in ready if r.name == name), None)
+            elif not ready:
+                victim = None
+            else:
+                def crowd(r):
+                    return sum(1 for o in ready if o.zone == r.zone)
+                victim = max(reversed(ready), key=crowd)
+            if victim is None:
+                return None
+            victim.state = DRAINING
+        eng = victim.engine
+        eng.retire(timeout=timeout)
+        if eng.alive() or eng.crashed is not None:
+            self._fail_over(victim, "drain timeout"
+                            if eng.alive() else f"crashed mid-drain: "
+                            f"{eng.crashed}")
+        with self._lock:
+            if victim in self._replicas:
+                self._replicas.remove(victim)
+            victim.state = STOPPED
+            self._stats["replicas_retired"] += 1
+        log = self._telemetry
+        if log is not None:
+            attrs = dict(replica=victim.name, incarnation=eng.uid)
+            if victim.zone is not None:
+                attrs["zone"] = victim.zone
+            log.event("replica_retired", **attrs)
+            log.flush()
+        return victim.name
+
+    def _pick_zone(self) -> Optional[str]:
+        """Least-populated zone that is not down (ties: config order)."""
+        zones = self.config.zones
+        if not zones:
+            return None
+        with self._lock:
+            counts = {z: 0 for z in zones}
+            for r in self._replicas:
+                if r.zone in counts and r.state != STOPPED:
+                    counts[r.zone] += 1
+            alive = [z for z in zones if z not in self._zones_down]
+        return min(alive or list(zones), key=lambda z: counts[z])
 
     # ------------------------------------------------------------------
     # submission (admission control lives here)
@@ -271,7 +406,7 @@ class ReplicaPool:
         if not cfg.max_queue and not cfg.shed_wait_s:
             return
         qlen = len(self._queue)
-        ready = sum(r.state == READY for r in self._replicas)
+        ready = sum(r.state == READY for r in list(self._replicas))
         svc = self._svc_ewma if self._svc_ewma is not None else 0.1
         capacity = max(1, ready) * cfg.max_batch
         est_wait = (qlen + 1) * svc / capacity
@@ -299,7 +434,7 @@ class ReplicaPool:
     # attempts (dispatch, transfer, failover, hedge)
     # ------------------------------------------------------------------
     def _dispatch(self, st: _Client, first: bool = False,
-                  avoid: Optional[str] = None) -> InferenceRequest:
+                  avoid=None) -> InferenceRequest:
         """Create + enqueue one attempt for ``st`` (pool lock held).
         Only the FIRST attempt carries the admission timeout — a
         failover/hedge attempt already won admission once and must not
@@ -381,8 +516,26 @@ class ReplicaPool:
                 # a sibling attempt (hedge) is still in flight — let it
                 # decide the client's fate
                 return
-            c.error = att.error
-            c._resolve(att.status, att.error)
+            if (att.status == CANCELLED
+                    and att.error == ABANDON_HANDBACK
+                    and not c.done() and self._accepting):
+                # the abandoned engine popped this attempt AFTER the
+                # failover snapshot and handed it back on exit —
+                # re-dispatch to a survivor (exactly-once holds: the
+                # client is unresolved and the old attempt already lost)
+                new = self._dispatch(st, avoid=att.admitted_by)
+                self._stats["failovers"] += 1
+            else:
+                c.error = att.error
+                c._resolve(att.status, att.error)
+                return
+        log = self._telemetry
+        if log is not None:
+            log.event("request_failover", request_id=st.req.request_id,
+                      from_replica=(att.admitted_by or "").split("#")[0],
+                      attempt=new.request_id, reason="abandon handback",
+                      **_reqtrace.tag(st.req.trace))
+            log.counter("serve_failovers", 1)
 
     def _on_client_done(self, st: _Client, req: InferenceRequest) -> None:
         """Client resolved (transfer, shed, cancel, drain): cancel any
@@ -406,10 +559,16 @@ class ReplicaPool:
         self._svc_ewma = dt if self._svc_ewma is None \
             else 0.8 * self._svc_ewma + 0.2 * dt
 
-    def _fail_over(self, rep: _Replica, reason: str) -> int:
-        """Move a down replica's in-flight attempts to survivors."""
+    def _fail_over(self, rep: _Replica, reason: str,
+                   extra_avoid: Sequence[str] = ()) -> int:
+        """Move a down replica's in-flight attempts to survivors.
+        ``extra_avoid`` widens the avoid-key set beyond the dead
+        incarnation (a zone outage adds ``zone:<z>`` so NO replica in
+        the dead zone can pop the re-dispatch)."""
         eng = rep.engine
         eng.abandon()
+        avoid = eng.uid if not extra_avoid \
+            else (eng.uid,) + tuple(extra_avoid)
         moved = 0
         for att in eng.active_requests():
             with self._lock:
@@ -419,7 +578,7 @@ class ReplicaPool:
                     continue
                 st.attempts.remove(att)
                 self._attempts.pop(att.request_id, None)
-                new = self._dispatch(st, avoid=eng.uid)
+                new = self._dispatch(st, avoid=avoid)
             # cancel AFTER untracking: the dead incarnation waking up
             # and resolving the old attempt is now a guaranteed no-op
             att.cancel(f"failover: {reason}", force=True)
@@ -456,15 +615,66 @@ class ReplicaPool:
                 self._begin_drain(f"signal {self._preemption.signum}")
                 break
             now = time.perf_counter()
-            for rep in self._replicas:
+            self._check_zone_outage(now)
+            for rep in list(self._replicas):
                 if rep.state == READY:
                     bad = self._diagnose(rep.engine, now)
                     if bad is not None:
                         self._mark_down(rep, bad, now)
-                elif rep.state == RESTARTING and now >= rep.restart_at:
+                elif rep.state == RESTARTING and now >= rep.restart_at \
+                        and (rep.zone is None
+                             or rep.zone not in self._zones_down):
+                    # a replica in a chaos-downed zone stays down in
+                    # place; the autoscaler backfills elsewhere
                     self._restart(rep)
+            self._emit_ready_gauge()
             if cfg.hedge_ms:
                 self._hedge_scan(now)
+
+    def _check_zone_outage(self, now: float) -> None:
+        """Poll the chaos monkey's recorded zone-outage state: a newly
+        down zone marks EVERY ready replica in it down at once, and all
+        their stranded attempts fail over with the zone in the avoid
+        set (exactly-once: the usual attempt CAS)."""
+        mk = self._chaos
+        zones = self.config.zones
+        if mk is None or not zones:
+            return
+        for zi in tuple(getattr(mk, "zones_down", ()) or ()):
+            z = zones[int(zi) % len(zones)]
+            if z in self._zones_down:
+                continue
+            self._zones_down.add(z)
+            self._stats["zone_outages"] += 1
+            victims = [r for r in list(self._replicas)
+                       if r.zone == z and r.state == READY]
+            log = self._telemetry
+            if log is not None:
+                log.event("zone_down", zone=z,
+                          replicas=[r.name for r in victims])
+                log.counter("serve_zone_outages", 1, zone=z)
+                log.flush()
+            for rep in victims:
+                self._mark_down(rep, f"zone outage: {z}", now,
+                                extra_avoid=(f"zone:{z}",))
+
+    def _emit_ready_gauge(self) -> None:
+        """pool_ready_replicas (+ per-zone) on every change — the
+        replica-count timeline serve_report and fleet_bench plot."""
+        log = self._telemetry
+        if log is None:
+            return
+        reps = list(self._replicas)
+        ready = sum(r.state == READY for r in reps)
+        if ready == self._last_ready_gauge:
+            return
+        self._last_ready_gauge = ready
+        log.gauge("pool_ready_replicas", ready)
+        for z in self.config.zones:
+            log.gauge("pool_zone_ready",
+                      sum(r.state == READY for r in reps if r.zone == z),
+                      zone=z)
+        log.flush()
 
     def _diagnose(self, eng: InferenceEngine, now: float) -> Optional[str]:
         if eng.crashed is not None:
@@ -478,7 +688,8 @@ class ReplicaPool:
                     f"{self.config.replica_timeout_s:g})")
         return None
 
-    def _mark_down(self, rep: _Replica, reason: str, now: float) -> None:
+    def _mark_down(self, rep: _Replica, reason: str, now: float,
+                   extra_avoid: Sequence[str] = ()) -> None:
         rep.state = RESTARTING
         rep.fails += 1
         delay = backoff_delay(rep.fails, self.config.restart_backoff_s,
@@ -492,7 +703,7 @@ class ReplicaPool:
                       consecutive_fails=rep.fails,
                       restart_in_s=round(delay, 3))
             log.flush()
-        self._fail_over(rep, reason)
+        self._fail_over(rep, reason, extra_avoid=extra_avoid)
 
     def _restart(self, rep: _Replica) -> None:
         try:
@@ -534,7 +745,8 @@ class ReplicaPool:
                     continue
                 st.hedged = True
                 self._stats["hedged"] += 1
-                second = self._dispatch(st, avoid=att.admitted_by)
+                second = self._dispatch(
+                    st, avoid=self._hedge_avoid(att.admitted_by))
                 log = self._telemetry
                 if log is not None:
                     log.event("request_hedged",
@@ -545,6 +757,23 @@ class ReplicaPool:
                               **_reqtrace.tag(c.trace))
                     log.counter("serve_hedged", 1)
                     log.flush()
+
+    def _hedge_avoid(self, incarnation: Optional[str]):
+        """Avoid keys for a hedge: the first attempt's incarnation —
+        plus its whole ZONE when another zone still has a ready replica
+        (spread the race across failure domains, not just engines)."""
+        if incarnation is None:
+            return None
+        zone = next((r.zone for r in list(self._replicas)
+                     if r.engine is not None
+                     and r.engine.uid == incarnation), None)
+        if zone is None:
+            return incarnation
+        other_zone_ready = any(
+            r.state == READY and r.zone != zone
+            for r in list(self._replicas))
+        return (incarnation, f"zone:{zone}") if other_zone_ready \
+            else incarnation
 
     # ------------------------------------------------------------------
     # drain
@@ -561,7 +790,7 @@ class ReplicaPool:
                       queued=len(self._queue),
                       inflight=len(self._clients))
             log.flush()
-        for rep in self._replicas:
+        for rep in list(self._replicas):
             if rep.engine is not None and rep.state == READY:
                 rep.engine.stop(drain=True)
             rep.state = STOPPED
@@ -584,6 +813,19 @@ class ReplicaPool:
         return len(self._replicas)
 
     @property
+    def ready_replicas(self) -> int:
+        return sum(r.state == READY for r in list(self._replicas))
+
+    @property
+    def service_time_ewma(self) -> Optional[float]:
+        """Submit->done seconds EWMA (None before the first done)."""
+        return self._svc_ewma
+
+    def zones_down(self) -> frozenset:
+        """Zones chaos has marked down (names, not indices)."""
+        return frozenset(self._zones_down)
+
+    @property
     def num_queued(self) -> int:
         return len(self._queue)
 
@@ -595,41 +837,54 @@ class ReplicaPool:
     def ready(self) -> bool:
         """Readiness: accepting AND at least one replica can serve."""
         return self._accepting \
-            and any(r.state == READY for r in self._replicas)
+            and any(r.state == READY for r in list(self._replicas))
 
     def healthz(self) -> Dict[str, Any]:
         """Liveness detail (the HTTP ``/healthz`` body)."""
         now = time.perf_counter()
         reps = []
-        for r in self._replicas:
+        for r in list(self._replicas):
             e = r.engine
-            reps.append(dict(
+            d = dict(
                 name=r.name, state=r.state,
                 incarnation=e.uid if e is not None else None,
                 beat_age_s=round(now - e.last_beat, 3)
                 if e is not None else None,
                 active=e.num_active if e is not None else 0,
                 fails=r.fails, restarts=r.restarts,
-                failovers=r.failovers))
+                failovers=r.failovers)
+            if r.zone is not None:
+                d["zone"] = r.zone
+            reps.append(d)
         any_ready = any(r["state"] == READY for r in reps)
         if self._draining:
             status = "draining" if any_ready else "stopped"
         else:
             status = "ok" if any_ready else "down"
-        return dict(status=status, accepting=self._accepting,
-                    queued=len(self._queue),
-                    inflight=self.num_inflight, replicas=reps)
+        out = dict(status=status, accepting=self._accepting,
+                   queued=len(self._queue),
+                   inflight=self.num_inflight, replicas=reps)
+        if self.config.zones:
+            out["zones"] = {
+                z: dict(ready=sum(r["state"] == READY for r in reps
+                                  if r.get("zone") == z),
+                        total=sum(r.get("zone") == z for r in reps),
+                        down=z in self._zones_down)
+                for z in self.config.zones}
+        return out
 
     def stats(self) -> Dict[str, Any]:
+        reps = list(self._replicas)
         s = dict(self._stats)
         s["queued"] = len(self._queue)
         s["inflight"] = self.num_inflight
-        s["ready_replicas"] = sum(
-            r.state == READY for r in self._replicas)
+        s["ready_replicas"] = sum(r.state == READY for r in reps)
+        if self.config.zones:
+            s["zones_down"] = sorted(self._zones_down)
         s["replicas"] = {
-            r.name: dict(state=r.state, fails=r.fails,
+            r.name: dict(state=r.state, zone=r.zone, fails=r.fails,
                          restarts=r.restarts, failovers=r.failovers,
                          engine=r.engine.stats()
                          if r.engine is not None else {})
-            for r in self._replicas}
+            for r in reps}
         return s

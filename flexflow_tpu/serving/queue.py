@@ -18,7 +18,7 @@ import heapq
 import itertools
 import threading
 import time
-from typing import List, Optional
+from typing import Collection, List, Optional, Union
 
 import numpy as np
 
@@ -81,10 +81,12 @@ class InferenceRequest:
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
         self.admit_seq: Optional[int] = None  # engine admission order
-        # replica-pool fields: ``avoid`` names an engine uid that must
-        # NOT pop this request (hedge/failover re-dispatch targets a
-        # different replica); ``admitted_by`` is stamped at admission
-        self.avoid: Optional[str] = None
+        # replica-pool fields: ``avoid`` names an engine uid — or a
+        # tuple of keys (engine uid, "zone:<z>") — that must NOT pop
+        # this request (hedge/failover re-dispatch targets a different
+        # replica, and with zones a different failure domain);
+        # ``admitted_by`` is stamped at admission
+        self.avoid: Union[None, str, tuple] = None
         self.admitted_by: Optional[str] = None
         # request-scoped tracing (observability/reqtrace.TraceContext):
         # minted once at admission when telemetry is on, None otherwise.
@@ -191,6 +193,8 @@ class RequestQueue:
         self._nonempty = threading.Condition(self._lock)
         self._heap: List = []          # (-priority, seq, req)
         self._seq = itertools.count()
+        self._sweep_stop: Optional[threading.Event] = None
+        self._sweeper: Optional[threading.Thread] = None
 
     def __len__(self) -> int:
         with self._lock:
@@ -211,12 +215,14 @@ class RequestQueue:
         self.expire(now)
 
     def pop_ready(self, now: float,
-                  avoid_key: Optional[str] = None) -> Optional[InferenceRequest]:
+                  avoid_key: Union[None, str, Collection[str]] = None
+                  ) -> Optional[InferenceRequest]:
         """Highest-priority live request, resolving any expired ones
         encountered on the way (their callers unblock with TIMEOUT).
         Requests already resolved externally (caller cancel, hedge
-        winner) are dropped; requests whose ``avoid`` matches
-        ``avoid_key`` are left queued for a DIFFERENT replica."""
+        winner) are dropped; requests whose ``avoid`` keys intersect
+        ``avoid_key`` (either side may be a single key or a collection
+        of keys) are left queued for a DIFFERENT replica."""
         expired: List[InferenceRequest] = []
         skipped: List = []
         got: Optional[InferenceRequest] = None
@@ -229,7 +235,7 @@ class RequestQueue:
                 if self._expired(req, now):
                     expired.append(req)
                     continue
-                if avoid_key is not None and req.avoid == avoid_key:
+                if self._avoided(req.avoid, avoid_key):
                     skipped.append(entry)
                     continue
                 got = req
@@ -239,6 +245,14 @@ class RequestQueue:
         for req in expired:     # resolve OUTSIDE the lock: callbacks
             req._resolve(TIMEOUT)
         return got
+
+    @staticmethod
+    def _avoided(avoid, avoid_key) -> bool:
+        if avoid is None or avoid_key is None:
+            return False
+        av = (avoid,) if isinstance(avoid, str) else avoid
+        keys = (avoid_key,) if isinstance(avoid_key, str) else avoid_key
+        return any(a in keys for a in av)
 
     def expire(self, now: float) -> int:
         """Resolve every expired queued request (runs at each token
@@ -278,6 +292,39 @@ class RequestQueue:
             if self._heap:
                 return True
             return self._nonempty.wait(timeout)
+
+    # -- standalone expiry sweeper --------------------------------------
+    # The put/pop/wait sweeps above only run while SOMEONE is moving the
+    # queue.  During a pool drain (or after an engine wedges) nothing
+    # puts or pops, so a parked request could outlive its deadline — and
+    # its caller's give-up cancel in ``result()`` would be the only way
+    # out.  The sweeper keeps expiry and caller-cancel resolution
+    # flowing no matter what the engines are doing.
+    def start_sweeper(self, interval_s: float = 0.05) -> None:
+        """Start a daemon thread sweeping expiry every ``interval_s``
+        seconds.  Idempotent; ``stop_sweeper`` ends it."""
+        with self._lock:
+            if self._sweeper is not None and self._sweeper.is_alive():
+                return
+            stop = threading.Event()
+            t = threading.Thread(
+                target=self._sweep_loop, args=(stop, float(interval_s)),
+                name="ff-queue-sweeper", daemon=True)
+            self._sweep_stop, self._sweeper = stop, t
+        t.start()
+
+    def stop_sweeper(self, timeout: float = 2.0) -> None:
+        with self._lock:
+            stop, t = self._sweep_stop, self._sweeper
+            self._sweep_stop = self._sweeper = None
+        if stop is not None:
+            stop.set()
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def _sweep_loop(self, stop: threading.Event, interval_s: float) -> None:
+        while not stop.wait(interval_s):
+            self.expire(time.perf_counter())
 
     @staticmethod
     def _expired(req: InferenceRequest, now: float) -> bool:

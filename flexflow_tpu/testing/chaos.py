@@ -46,8 +46,18 @@ Spec grammar (``FF_CHAOS`` environment variable)::
                               replica's loop thread for ``arg`` seconds
                               (default 3600) so the pool's heartbeat
                               monitor declares it stalled
+               | "zone_outage"   (serve site) zone ``arg`` (an index
+                              into FF_SERVE_ZONES, default 0) goes dark
+                              — recorded on ``zones_down``; the pool's
+                              monitor marks EVERY replica in that zone
+                              down at once, fails their in-flight
+                              attempts over to surviving zones, and the
+                              autoscaler backfills capacity there.
+                              Recorded state like device_loss: the
+                              admitting request itself is unharmed.
     arg        = FLOAT        fault parameter (hang seconds, lost/regained
-                              device count, per-step inflation seconds)
+                              device count, per-step inflation seconds,
+                              zone index)
 
 For the ``step`` site the trigger is the model's GLOBAL step index
 (``model._step_count`` at ``update()`` entry) — resume-aware, so an
@@ -112,7 +122,7 @@ SITES = ("step", "data", "ckpt_save", "ckpt_restore", "sync", "serve",
          "resharding")
 FAULTS = ("nan_loss", "hang", "io_error", "sigterm", "sigint", "error",
          "device_loss", "device_gain", "divergence",
-         "replica_kill", "replica_hang")
+         "replica_kill", "replica_hang", "zone_outage")
 
 
 class ChaosError(RuntimeError):
@@ -221,6 +231,9 @@ class ChaosMonkey:
         self.fired: List[Tuple[str, int, str]] = []  # (site, trigger, fault)
         # resharding-site state, read by the reconfiguration controller
         self.lost_device_count = 0
+        # serve-site state, read by the replica pool's monitor: indices
+        # into FF_SERVE_ZONES whose replicas went dark all at once
+        self.zones_down: List[int] = []
         # persistent per-step wall inflation (``divergence`` fault)
         self.inflate_step_s = 0.0
 
@@ -301,6 +314,13 @@ class ChaosMonkey:
                 f"chaos-injected replica crash at {where}")
         elif fault == "replica_hang":
             time.sleep(arg if arg is not None else 3600.0)
+        elif fault == "zone_outage":
+            # recorded state (like device_loss): the pool monitor polls
+            # ``zones_down`` and downs every replica of the zone; the
+            # admitting request itself proceeds unharmed
+            zi = int(arg) if arg is not None else 0
+            if zi not in self.zones_down:
+                self.zones_down.append(zi)
 
     @staticmethod
     def _poison_batch(model, where: str) -> None:

@@ -24,6 +24,15 @@ restart the dead replica, the trace must narrate the lifecycle
 (``replica_down`` / ``request_failover`` / ``replica_restart``), and
 the goodput headline lands in ``BENCH_SERVE.json``.
 
+``zone_outage`` — a chaos-injected loss of a WHOLE ZONE (``zone_outage``
+fault) in a 4-replica, 2-zone pool with the autoscaler running; every
+request — including the ones in flight in the dead zone — must still
+complete with tokens BITWISE-equal to ``FFModel.generate()``, the
+re-dispatches must avoid the dead zone (``zone:<z>`` avoid-key), the
+autoscaler must backfill the surviving zone back to ``min_replicas``
+within its cooldown budget, and the trace must narrate the incident
+(``zone_down`` / ``request_failover`` / ``scale_event``).
+
 Run by ``test.sh``; also a handy pod-shell sanity check after touching
 the robustness layer.
 
@@ -31,6 +40,7 @@ Usage:
     python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/chaos
     python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/rs --scenario reshard
     python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/sf --scenario serve_failover
+    python -m flexflow_tpu.testing.chaos_smoke --workdir /tmp/zo --scenario zone_outage
 """
 
 from __future__ import annotations
@@ -88,16 +98,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--workdir", required=True,
                    help="scratch dir for checkpoints + traces")
     p.add_argument("--scenario",
-                   choices=("recovery", "reshard", "serve_failover"),
+                   choices=("recovery", "reshard", "serve_failover",
+                            "zone_outage"),
                    default="recovery",
                    help="recovery = NaN/SIGTERM/io_error resume drill; "
                         "reshard = chaos device loss + hot-swap failover; "
-                        "serve_failover = replica kill in a serving pool")
+                        "serve_failover = replica kill in a serving pool; "
+                        "zone_outage = whole-zone loss + autoscaler "
+                        "backfill")
     args = p.parse_args(argv)
     os.makedirs(args.workdir, exist_ok=True)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if args.scenario == "serve_failover":
         return _scenario_serve_failover(args.workdir)
+    if args.scenario == "zone_outage":
+        return _scenario_zone_outage(args.workdir)
     if args.scenario == "reshard":
         # the failover drill needs a mesh to shrink — must be set before
         # the first jax import touches the backend
@@ -335,6 +350,91 @@ def _scenario_serve_failover(wd: str) -> int:
     print(f"bench: goodput {bench['goodput_rps']:.2f} req/s -> {out}",
           flush=True)
     print("SERVE FAILOVER SMOKE OK")
+    return 0
+
+
+def _scenario_zone_outage(wd: str) -> int:
+    import time
+
+    import numpy as np
+
+    from ..observability import events
+    from ..serving import Autoscaler, ScaleConfig, ServeConfig
+    from ..serving.pool import ReplicaPool
+
+    NEW = 8
+    N_REQ = 12
+    trace = os.path.join(wd, "zone_trace.jsonl")
+    # 6th pool-wide admission downs zone index 1 ("zone-b"): BOTH of its
+    # replicas go dark at once, stranding whatever they hold in flight
+    _phase({"FF_CHAOS": "serve:6=zone_outage:1", "FF_TELEMETRY": "1",
+            "FF_TELEMETRY_FILE": trace})
+    m = _build_serve_model()
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 32, size=int(rng.integers(3, 11)))
+               .astype(np.int32) for _ in range(N_REQ)]
+    want = [m.generate(p[None], NEW)[0] for p in prompts]
+
+    cfg = ServeConfig(max_batch=2, max_seq=64, max_new_tokens=NEW,
+                      replicas=4, zones=("zone-a", "zone-b"),
+                      replica_timeout_s=120.0,
+                      restart_backoff_s=0.05, restart_cap_s=0.2)
+    scale = ScaleConfig(min_replicas=4, max_replicas=6, interval_s=0.05,
+                        streak=2, up_cooldown_s=0.1, down_cooldown_s=30.0)
+    pool = ReplicaPool(m, config=cfg)
+    pool.start()
+    scaler = Autoscaler(pool, scale)
+    scaler.start()
+    t0 = time.perf_counter()
+    reqs = [pool.submit(p, NEW) for p in prompts]
+    outs = [r.result(180) for r in reqs]
+    wall = time.perf_counter() - t0
+
+    # exactly-once through the outage: every request — the queued ones
+    # AND the ones stranded in the dead zone — completed bitwise-equal
+    bad = [i for i, (got, w) in enumerate(zip(outs, want))
+           if not np.array_equal(np.asarray(got, np.int32), w)]
+    assert not bad, f"zone failover broke greedy equivalence for {bad}"
+    st = pool.stats()
+    assert st["zone_outages"] >= 1, f"chaos zone_outage never landed: {st}"
+    assert st["replica_downs"] >= 2, \
+        f"a whole zone (2 replicas) should be down: {st}"
+    assert st["failovers"] >= 1, f"no stranded request failed over: {st}"
+    assert "zone-b" in pool.zones_down(), pool.zones_down()
+
+    # the autoscaler must backfill the surviving zone to min_replicas
+    # (the 2 dead replicas stay down — their zone is dark)
+    deadline = time.perf_counter() + 30
+    while time.perf_counter() < deadline:
+        if pool.ready_replicas >= scale.min_replicas:
+            break
+        time.sleep(0.05)
+    hz = pool.healthz()
+    assert pool.ready_replicas >= scale.min_replicas, hz
+    assert hz["zones"]["zone-b"]["down"], hz["zones"]
+    assert hz["zones"]["zone-b"]["ready"] == 0, hz["zones"]
+    assert hz["zones"]["zone-a"]["ready"] >= scale.min_replicas, \
+        hz["zones"]
+    st = pool.stats()
+    assert st["replicas_added"] >= 2, st
+    sst = scaler.stats()
+    scaler.stop()
+    pool.stop()
+    events.reset_active()
+    print(f"pool: {st['completed']}/{N_REQ} completed bitwise-equal · "
+          f"zone-b down ({st['replica_downs']} replicas), "
+          f"{st['failovers']} failovers, {st['replicas_added']} backfills "
+          f"({sst['scale_ups']} scale-ups)", flush=True)
+
+    # the trace narrates the incident end to end
+    names = [json.loads(l).get("name") for l in open(trace) if l.strip()]
+    for ev in ("zone_down", "request_failover", "scale_event",
+               "replica_added"):
+        assert ev in names, f"{ev} missing from trace (saw {set(names)})"
+    print(f"trace: zone incident narrated ({trace})", flush=True)
+    print(f"wall: {wall:.2f}s for {N_REQ} requests through the outage",
+          flush=True)
+    print("ZONE OUTAGE SMOKE OK")
     return 0
 
 

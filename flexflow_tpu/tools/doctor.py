@@ -387,6 +387,34 @@ def _serving():
     return ", ".join(bits)
 
 
+def _autoscaler():
+    # Effective FF_SCALE_* env as serving/autoscaler.py will see it (a
+    # typo'd knob raises HERE, not when the scaler thread starts), plus
+    # the fleet-shape cross-checks: zones without the headroom to
+    # rebuild one, or a scaler flying blind without telemetry.
+    from ..serving.autoscaler import ScaleConfig
+    from ..serving.config import ServeConfig
+
+    cfg = ScaleConfig.from_env()   # ValueError on a typo'd env var
+    bits = [cfg.describe()]
+    if not cfg.enabled:
+        bits.append("pool size is static")
+        return ", ".join(bits)
+    serve = ServeConfig.from_env()
+    if serve.zones and cfg.max_replicas < 2 * len(serve.zones):
+        bits.append(
+            f"WARN: FF_SCALE_MAX={cfg.max_replicas} < 2x "
+            f"{len(serve.zones)} zones — after a zone outage the "
+            f"survivors cannot rebuild full redundancy")
+    if not os.environ.get("FF_TELEMETRY") \
+            and not os.environ.get("FF_METRICS_PORT"):
+        bits.append(
+            "WARN: autoscaler enabled without FF_TELEMETRY or "
+            "FF_METRICS_PORT — scale decisions and burn-rate inputs "
+            "will be invisible")
+    return ", ".join(bits)
+
+
 def _search():
     # Effective FF_SEARCH_* env as simulator/population.py will see it —
     # a typo'd knob fails HERE (ValueError in the detail) instead of at
@@ -536,6 +564,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              ("resilience", _resilience, False),
              ("reconfiguration", _reconfiguration, False),
              ("serving", _serving, False),
+             ("autoscaler", _autoscaler, False),
              ("cpu training", _cpu_train, True)]
 
     # print each line as its check completes — the slow checks (90s

@@ -11,6 +11,13 @@ and completions plus the pool lifecycle (``replica_down`` /
 ``replica_restart`` / ``request_failover`` / ``request_hedged`` /
 ``request_shed`` / ``pool_drain`` events).
 
+Fleet runs (pool + autoscaler, ``tools/fleet_bench.py``) additionally
+get "## Fleet": the ready-replica-count timeline
+(``pool_ready_replicas`` / per-zone ``pool_zone_ready`` gauges), every
+``scale_event`` with its reason, zone incidents (``zone_down``), the
+``replica_added`` / ``replica_retired`` churn, and per-zone batch
+occupancy (the ``zone`` attr the engines stamp on their gauges).
+
 Traced runs (records stamped with ``trace_id`` — any telemetry run
 since reqtrace landed) also get "## Slow requests": the top-5 traces by
 end-to-end latency, each as a queue-wait -> prefill -> decode waterfall
@@ -63,6 +70,12 @@ def render_report(records: List[Dict[str, Any]],
     window_mix: Dict[int, float] = {}        # decode window -> steps
     _POOL_EVENTS = ("replica_down", "replica_restart", "request_failover",
                     "request_hedged", "request_shed", "pool_drain")
+    _FLEET_EVENTS = ("scale_event", "zone_down", "replica_added",
+                     "replica_retired", "replica_add_failed")
+    fleet_events: List[Dict[str, Any]] = []  # autoscaler/zone lifecycle
+    ready_tl: List[tuple] = []               # (ts, pool_ready_replicas)
+    zone_ready: Dict[str, List[tuple]] = {}  # zone -> (ts, ready)
+    occ_by_zone: Dict[str, List[float]] = {}  # zone -> gauge values
     _TRACE_SPANS = ("serve_request", "serve_attempt", "serve_queue_wait",
                     "serve_prefill", "serve_decode", "serve_decode_chunk")
     trace_spans: Dict[str, List[dict]] = {}   # trace_id -> its spans
@@ -82,12 +95,26 @@ def render_report(records: List[Dict[str, Any]],
             done_events.append(r)
         elif t == "event" and name in _POOL_EVENTS:
             pool_events.append(r)
+        elif t == "event" and name in _FLEET_EVENTS:
+            fleet_events.append(r)
+        elif t == "gauge" and name == "pool_ready_replicas":
+            ready_tl.append((float(r.get("ts", 0.0)),
+                             float(r.get("v", 0.0))))
+        elif t == "gauge" and name == "pool_zone_ready":
+            z = r.get("attrs", {}).get("zone")
+            if z:
+                zone_ready.setdefault(z, []).append(
+                    (float(r.get("ts", 0.0)), float(r.get("v", 0.0))))
         elif t == "gauge" and name == "serve_batch_occupancy":
             v = float(r.get("v", 0.0))
             occ.append((float(r.get("ts", 0.0)), v))
-            rep = r.get("attrs", {}).get("replica")
+            a = r.get("attrs", {})
+            rep = a.get("replica")
             if rep:
                 occ_by_rep.setdefault(rep, []).append(v)
+            z = a.get("zone")
+            if z:
+                occ_by_zone.setdefault(z, []).append(v)
         elif t == "span" and name == "serve_prefill":
             admits.append(float(r.get("ts", 0.0)))
         elif t == "span" and name == "serve_decode":
@@ -257,6 +284,81 @@ def render_report(records: List[Dict[str, Any]],
                          f"{a.get('inflight', 0)} in flight, "
                          f"{a.get('queued', 0)} queued)")
         lines.append("")
+
+    # ---- fleet (pool + autoscaler runs) -------------------------------
+    if ready_tl or fleet_events:
+        lines += ["## Fleet", ""]
+        if ready_tl:
+            vals = [v for _, v in ready_tl]
+            lines.append(f"- ready replicas: start {vals[0]:g} · "
+                         f"min {min(vals):g} · max {max(vals):g} · "
+                         f"end {vals[-1]:g} "
+                         f"({len(ready_tl)} transitions)")
+            for z in sorted(zone_ready):
+                zv = [v for _, v in zone_ready[z]]
+                lines.append(f"- zone `{z}` ready: min {min(zv):g} · "
+                             f"max {max(zv):g} · end {zv[-1]:g}")
+            lines.append("")
+            shown = ready_tl[:20]
+            lines += ["| t (s) | ready | |", "|---|---|---|"]
+            for ts, v in shown:
+                bar = "#" * max(1, int(v))
+                lines.append(f"| {ts:.2f} | {v:g} | `{bar}` |")
+            if len(ready_tl) > len(shown):
+                lines.append(f"| ... | ({len(ready_tl) - len(shown)} "
+                             "more) | |")
+            lines.append("")
+        churn = {n: sum(1 for e in fleet_events if e.get("name") == n)
+                 for n in ("scale_event", "zone_down", "replica_added",
+                           "replica_retired", "replica_add_failed")}
+        if any(churn.values()):
+            lines.append(
+                f"- {churn['scale_event']} scale events · "
+                f"{churn['replica_added']} added / "
+                f"{churn['replica_retired']} retired"
+                + (f" / {churn['replica_add_failed']} add-failed"
+                   if churn["replica_add_failed"] else "")
+                + (f" · {churn['zone_down']} zone outage"
+                   f"{'s' if churn['zone_down'] != 1 else ''}"
+                   if churn["zone_down"] else ""))
+            lines.append("")
+        for e in sorted(fleet_events,
+                        key=lambda e: float(e.get("ts", 0.0)))[:30]:
+            a = e.get("attrs", {})
+            ts = float(e.get("ts", 0.0))
+            n = e.get("name")
+            if n == "scale_event":
+                lines.append(
+                    f"- t={ts:.2f}s scale {a.get('direction', '?')} -> "
+                    f"`{a.get('replica', '?')}` "
+                    f"({a.get('reason', '?')}; ready "
+                    f"{a.get('ready_before', '?')}->"
+                    f"{a.get('ready_after', '?')}, "
+                    f"queued {a.get('queued', '?')})")
+            elif n == "zone_down":
+                lines.append(
+                    f"- t={ts:.2f}s **zone `{a.get('zone', '?')}` DOWN** "
+                    f"(replicas: "
+                    f"{', '.join(a.get('replicas', []) or ['?'])})")
+            elif n == "replica_add_failed":
+                lines.append(f"- t={ts:.2f}s replica add FAILED "
+                             f"({a.get('error', '?')})")
+            else:
+                verb = "added" if n == "replica_added" else "retired"
+                lines.append(f"- t={ts:.2f}s replica "
+                             f"`{a.get('replica', '?')}` {verb}"
+                             + (f" (zone `{a['zone']}`)"
+                                if a.get("zone") else ""))
+        if fleet_events:
+            lines.append("")
+        if occ_by_zone:
+            lines += ["| zone | boundaries | mean occupancy |",
+                      "|---|---|---|"]
+            for z in sorted(occ_by_zone):
+                zv = occ_by_zone[z]
+                lines.append(f"| {z} | {len(zv)} | "
+                             f"{sum(zv) / len(zv):.2f} |")
+            lines.append("")
 
     # ---- slow requests (traced runs) ----------------------------------
     done_by_trace: Dict[str, List[dict]] = {}

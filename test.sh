@@ -353,6 +353,47 @@ b = json.load(open('$SMOKE_DIR/serve_failover/BENCH_SERVE.json'))
 print(f\"goodput {b['goodput_rps']} req/s, \"
       f\"{b['pool']['failovers']} failovers\")"))"
 
+# Zone-outage smoke: chaos downs a WHOLE ZONE of a 4-replica, 2-zone
+# pool mid-load with the autoscaler running; every request (incl. the
+# dead zone's in-flight ones) must complete bitwise-equal to generate(),
+# re-dispatches must avoid the dead zone, and the autoscaler must
+# backfill the surviving zone (docs/robustness.md "Zone outages").
+python -m flexflow_tpu.testing.chaos_smoke --workdir "$SMOKE_DIR/zone_outage" \
+    --scenario zone_outage \
+  || { echo "zone-outage smoke: FAILED"; exit 1; }
+python -m flexflow_tpu.tools.serve_report "$SMOKE_DIR/zone_outage/zone_trace.jsonl" \
+  | grep -q "## Fleet" \
+  || { echo "zone-outage smoke: serve_report missing fleet section"; exit 1; }
+echo "zone-outage smoke: OK"
+
+# Fleet smoke: the seeded flash-crowd incident scenario against a live
+# pool+autoscaler — BENCH_FLEET.json must parse with zero lost/incorrect
+# responses and nonzero SLO goodput, and the run lands a fleet_goodput
+# perf-ledger entry (docs/serving.md "Fleet scenarios").  The zone
+# scenario is exercised (with asserts) by the zone-outage smoke above;
+# here the cheap traffic shape keeps the gate fast.
+python -m flexflow_tpu.tools.fleet_bench --scenarios flash_crowd \
+    --requests 10 --seed 0 --workdir "$SMOKE_DIR/fleet" \
+    --ledger "$SMOKE_DIR/fleet_ledger.jsonl" \
+  || { echo "fleet smoke: fleet_bench FAILED"; exit 1; }
+python - "$SMOKE_DIR/fleet/BENCH_FLEET.json" <<'EOF' \
+  || { echo "fleet smoke: BENCH_FLEET.json acceptance failed"; exit 1; }
+import json, sys
+b = json.load(open(sys.argv[1]))
+assert b["bench"] == "fleet" and b["scenarios"], b.keys()
+for name, s in b["scenarios"].items():
+    assert s["n_lost"] == 0 and s["n_incorrect"] == 0, (name, s)
+    assert s["goodput_rps"] > 0, (name, s["goodput_rps"])
+EOF
+grep -q '"metric": "fleet_goodput"' "$SMOKE_DIR/fleet_ledger.jsonl" \
+  || { echo "fleet smoke: no fleet_goodput ledger entry"; exit 1; }
+echo "fleet smoke: OK ($(python -c "
+import json
+b = json.load(open('$SMOKE_DIR/fleet/BENCH_FLEET.json'))
+s = b['scenarios']['flash_crowd']
+print(f\"goodput {s['goodput_rps']}/{s['offered_rps']} rps, \"
+      f\"attainment {s['slo_attainment']:.0%}\")"))"
+
 if [ -n "$RUN_EXAMPLES" ]; then
   for ex in examples/mnist_mlp_native.py \
             examples/keras/seq_mnist_mlp.py \

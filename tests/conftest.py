@@ -38,6 +38,38 @@ jax.config.update("jax_compilation_cache_dir",
                   os.environ.get("FF_TEST_JAX_CACHE", "/tmp/ff_test_jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+# The cache's put() writes the entry straight to its final name
+# (LRUCache.put -> Path.write_bytes, jax 0.4.37).  A test process
+# killed mid-write — suite timeout, OOM kill, ^C — leaves a TRUNCATED
+# entry under the real key, and every later process that deserializes
+# it dies with a general-protection fault deep inside jaxlib; one
+# poisoned entry turns the whole suite red until someone deletes the
+# cache dir by hand.  Make the write crash-atomic: stage under a
+# pid-suffixed temp key, then os.replace onto the final name.
+try:
+    from jax._src import lru_cache as _lru
+
+    _CACHE_SUF = getattr(_lru, "_CACHE_SUFFIX", "-cache")
+    _ATIME_SUF = getattr(_lru, "_ATIME_SUFFIX", "-atime")
+    _orig_put = _lru.LRUCache.put
+
+    def _crash_atomic_put(self, key, val):
+        tmp_key = f"{key}.tmp{os.getpid()}"
+        _orig_put(self, tmp_key, val)
+        for suf in (_CACHE_SUF, _ATIME_SUF):
+            src, dst = self.path / f"{tmp_key}{suf}", self.path / f"{key}{suf}"
+            try:
+                if dst.exists():        # another process won the race
+                    src.unlink()
+                else:
+                    os.replace(src, dst)
+            except OSError:
+                pass                    # best-effort: it's only a cache
+
+    _lru.LRUCache.put = _crash_atomic_put
+except Exception:                       # jax internals moved: skip hardening
+    pass
+
 import pytest  # noqa: E402
 
 
