@@ -726,6 +726,64 @@ def profile(out="/tmp/flexflow_tpu_trace"):
     print(f"-> trace in {out} (tensorboard --logdir {out})")
 
 
+def lowered_ab(name="alexnet"):
+    """A/B the whole-graph lowering (manual mode: `python bench.py
+    --lowered [model]`): the SAME model + strategy timed under per-op
+    dispatch (FF_LOWERED=0) and the ONE pjit'd lowered step
+    (FF_LOWERED=1, parallel/lowering.py).  Appends the ratio to the
+    perf ledger as ``lowering_speedup`` — backend-stamped and
+    proxy-gated like ``search_quality``, so a CPU run (where the
+    fallback wrapper makes both paths the identical jit call and the
+    ratio is noise around 1.0) never reads as a chip number."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/flexflow_tpu_jax_cache")
+    plat = jax.devices()[0].platform
+    batch = int(os.environ.get("FF_BENCH_LOWERED_BATCH",
+                               BENCH_SINGLE_CHIP_BATCH if plat == "tpu"
+                               else 16))
+    steps = int(os.environ.get("FF_BENCH_LOWERED_STEPS", "8"))
+    dtype = "bfloat16" if plat == "tpu" else PROXY_DTYPE
+    prior = os.environ.get("FF_LOWERED")
+    res = {}
+    try:
+        for label, knob in (("dispatch", "0"), ("lowered", "1")):
+            os.environ["FF_LOWERED"] = knob
+            model = _build_warm(name, batch, dtype)
+            assert (model._lowering is not None) == (knob == "1"), \
+                "FF_LOWERED knob did not take"
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                model.train_iteration()
+            model.sync()
+            dt = time.perf_counter() - t0
+            res[label] = steps * batch / dt
+    finally:
+        if prior is None:
+            os.environ.pop("FF_LOWERED", None)
+        else:
+            os.environ["FF_LOWERED"] = prior
+    speedup = res["lowered"] / res["dispatch"]
+    line = {"metric": "lowering_speedup", "value": round(speedup, 4),
+            "unit": "x", "backend": plat, "proxy": plat != "tpu",
+            "model": name, "batch": batch, "steps": steps,
+            "samples_per_sec_dispatch": round(res["dispatch"], 2),
+            "samples_per_sec_lowered": round(res["lowered"], 2)}
+    print(json.dumps(line), flush=True)
+    try:
+        pl = _ledger()
+        if pl is not None:
+            pl.append_entry({"kind": "bench", "metric": "lowering_speedup",
+                             "value": line["value"], "unit": "x",
+                             "backend": plat, "proxy": plat != "tpu",
+                             "status": "ok", "batch": batch,
+                             "provenance": {"model": name, "steps": steps}})
+    except Exception:
+        pass
+    return line
+
+
 def _flag_path(flag, default):
     """Optional path operand after ``flag``: only consume the next argv
     token when it isn't itself a flag (``--sweep --profile`` must not
@@ -741,6 +799,9 @@ def main():
         return
     if "--profile" in sys.argv:
         profile(_flag_path("--profile", "/tmp/flexflow_tpu_trace"))
+        return
+    if "--lowered" in sys.argv:
+        lowered_ab(_flag_path("--lowered", "alexnet"))
         return
 
     # Heartbeat file for phase-level wedge attribution (the framework

@@ -122,6 +122,9 @@ class FFModel:
         self._staged = False
         self._train_step_fn = None
         self._eval_step_fn = None
+        # Whole-graph lowering plan (parallel/lowering.GraphLowering);
+        # None = per-op dispatch.  Resolved by _compile_impl.
+        self._lowering = None
         self._fresh_jit = False  # next train-step build bypasses the
         #                          persistent compile cache (recompile)
         self._compiled = False
@@ -973,6 +976,14 @@ class FFModel:
         # Resolve operator placement (general pipeline parallelism) —
         # overrides the pipelined ops' configs with no-split placeholders.
         self._plan_pipeline()
+
+        # Whole-graph lowering (parallel/lowering.py): resolve the knob
+        # (FFConfig.lowered > FF_LOWERED > auto-on for multi-node runs,
+        # loud on garbage) and precompute each op's logical-axis sharding
+        # spec.  None = today's per-op dispatch; the step builders below
+        # route constraints and jit through the plan when it's set.
+        from .parallel import lowering as _ff_lowering
+        self._lowering = _ff_lowering.maybe_lowering(self)
 
         # Fused Pallas optimizer kernels: on a multi-device machine each
         # parameter's update runs inside a per-leaf shard_map with its
@@ -1831,8 +1842,12 @@ class FFModel:
                         hys = hop.forward(params.get(hop.param_key, {}),
                                           hxs, ctx)
                         if multi:
-                            hys = [self.machine.constraint(
-                                y, hop.constraint_pc()) for y in hys]
+                            if self._lowering is not None:
+                                hys = [self._lowering.constraint(y, hop)
+                                       for y in hys]
+                            else:
+                                hys = [self.machine.constraint(
+                                    y, hop.constraint_pc()) for y in hys]
                         for t, y in zip(hop.outputs, hys):
                             env[t.guid] = y
                 # Pipelined segment: GPipe microbatch schedule over the
@@ -1860,8 +1875,14 @@ class FFModel:
             else:
                 ys = op.forward(pvals, xs, ctx)
             if multi:
-                cpc = op.constraint_pc()
-                ys = [self.machine.constraint(y, cpc) for y in ys]
+                if self._lowering is not None:
+                    # Whole-graph lowering: constraints come from the
+                    # logical-axis rules (sample/attribute/parameter →
+                    # mesh axis classes) instead of the raw greedy map.
+                    ys = [self._lowering.constraint(y, op) for y in ys]
+                else:
+                    cpc = op.constraint_pc()
+                    ys = [self.machine.constraint(y, cpc) for y in ys]
             for t, y in zip(op.outputs, ys):
                 env[t.guid] = y
             i += 1
@@ -2043,8 +2064,13 @@ class FFModel:
                                       new_stats, new_opt, mvec, macc)
             return new_params, new_stats, new_opt, macc + mvec
 
-        fn = jax.jit(step if accum == 1 else step_accum,
-                     donate_argnums=(0, 1, 2, 6))
+        step_fn = step if accum == 1 else step_accum
+        if self._lowering is not None:
+            # ONE whole-graph pjit'd step (CPU fallback = the identical
+            # jax.jit call below, so tier-1 parity is by construction).
+            fn = self._lowering.jit_step(step_fn, donate_argnums=(0, 1, 2, 6))
+        else:
+            fn = jax.jit(step_fn, donate_argnums=(0, 1, 2, 6))
         if self._memplane is not None:
             fn = self._memplane.wrap("train_step", fn)
         return fn
@@ -2063,7 +2089,10 @@ class FFModel:
             msum["loss"] = loss
             return msum, env[probs_t.guid]
 
-        fn = jax.jit(estep)
+        if self._lowering is not None:
+            fn = self._lowering.jit_step(estep)
+        else:
+            fn = jax.jit(estep)
         if self._memplane is not None:
             fn = self._memplane.wrap("eval_step", fn)
         return fn
@@ -2549,7 +2578,6 @@ class FFModel:
                 tuple(sorted((k, v.shape) for k, v in extra.items())))
         run = cache.get(ckey)
         if run is None:
-            @jax.jit
             def run(params, stats, extra, feed, use, key0, temp):
                 pre_env = self._prefill_static(params, stats, extra,
                                                extra_guids, static_ops)
@@ -2562,6 +2590,8 @@ class FFModel:
                     carry0, (feed, use))
                 return outs                                   # (P+N-1, B)
 
+            run = (self._lowering.jit_step(run)
+                   if self._lowering is not None else jax.jit(run))
             if self._memplane is not None:
                 run = self._memplane.wrap(f"generate:{B}x{P}x{N}", run)
             cache[ckey] = run
@@ -2662,7 +2692,6 @@ class FFModel:
                 tuple(sorted((k, v.shape) for k, v in extra.items())))
         run = cache.get(ckey)
         if run is None:
-            @jax.jit
             def run(params, stats, extra, feed, use):
                 pre_env = self._prefill_static(params, stats, extra,
                                                extra_guids, static_ops,
@@ -2685,6 +2714,8 @@ class FFModel:
                     carry0, (feed, use, do_exp))
                 return buf.reshape(B, K, N), scores
 
+            run = (self._lowering.jit_step(run)
+                   if self._lowering is not None else jax.jit(run))
             if self._memplane is not None:
                 run = self._memplane.wrap(
                     f"beam_search:{B}x{P}x{N}x{K}", run)

@@ -258,10 +258,12 @@ def per_op_attribution(model, strategies,
                        compute_dtype: Optional[str] = None
                        ) -> Dict[str, Dict[str, Any]]:
     """Per-op cost attribution for a strategy map: ``{op: {dims, parts,
-    host, fwd_ms, bwd_ms}}`` priced by the non-measuring cost model —
-    the rows a ``.pb.meta.json`` sidecar carries so ``search_report
-    --diff`` can name the simulated cost impact of each changed op."""
+    host, spec, fwd_ms, bwd_ms}}`` priced by the non-measuring cost
+    model — the rows a ``.pb.meta.json`` sidecar carries so
+    ``search_report --diff`` can name the simulated cost impact (and
+    the resolved sharding-spec change) of each changed op."""
     from ..config import ParallelConfig
+    from ..parallel import lowering as _lowering
     from ..simulator.cost_model import CostModel
     from ..simulator.machine import TPUMachineModel
 
@@ -270,16 +272,29 @@ def per_op_attribution(model, strategies,
     mm = machine_model or TPUMachineModel.calibrated(num_devices=nd)
     cm = CostModel(mm, measure=False,
                    compute_dtype=compute_dtype or model.config.compute_dtype)
+    # Pure shadow of the mesh the lowering pass would target for this
+    # device count: spec strings are derivable offline, so sidecars
+    # written by search tools carry them even when no model compiled.
+    names, sizes = _lowering.hybrid_axis_layout(
+        nd, mm.num_hosts if nd % mm.chips_per_host == 0 else 1)
     rows: Dict[str, Dict[str, Any]] = {}
     for op in model.ops:
         pc = strategies.get(op.name) or getattr(op, "pc", None) \
             or ParallelConfig.data_parallel(op.output.num_dims, nd)
         pc = model._legalize_pc(op, pc) if hasattr(model, "_legalize_pc") \
             else pc
+        try:
+            groups, _ = _lowering.assign_axes(
+                names, sizes, pc.dims,
+                _lowering.dim_roles(op, len(pc.dims)))
+            spec = _lowering.spec_string(groups)
+        except ValueError:
+            spec = "?"  # degree the mesh cannot express; advisory only
         rows[op.name] = {
             "dims": "x".join(str(d) for d in pc.dims),
             "parts": pc.num_parts(),
             "host": bool(getattr(pc, "host_placed", False)),
+            "spec": spec,
             "fwd_ms": round(cm.op_time(op, pc, "forward") * 1e3, 4),
             "bwd_ms": round(cm.op_time(op, pc, "backward") * 1e3, 4),
         }
@@ -311,6 +326,16 @@ def build_provenance(model, strategies, engine: str, budget: int,
         meta["best_ms"] = round(float(best_s) * 1e3, 4)
     if dp_s is not None:
         meta["dp_ms"] = round(float(dp_s) * 1e3, 4)
+    # Whole-graph lowering stamp: was this strategy compiled into ONE
+    # pjit'd step (parallel/lowering.py), and what did each op's spec
+    # resolve to (including any dcn spill the search failed to avoid)?
+    low = getattr(model, "_lowering", None)
+    meta["lowered"] = low is not None
+    if low is not None:
+        try:
+            meta["lowering"] = low.plan()
+        except Exception as e:  # advisory; never block export
+            meta["lowering_error"] = repr(e)
     log = active_log()
     if log is not None:
         meta["search_run_id"] = log.run_id

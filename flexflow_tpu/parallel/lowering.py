@@ -1,0 +1,415 @@
+"""Whole-graph lowering: a resolved SOAP strategy → ONE jitted step.
+
+The search half of the framework picks a per-op ``ParallelConfig`` map;
+until now execution dispatched each op as an individually sharded
+fragment, so XLA never saw the whole program and could not fuse across
+op boundaries or overlap the collectives the strategy implies.  This
+module is the execution half (ROADMAP item 1): it lowers the strategy
+map into per-op ``with_sharding_constraint`` specs inside ONE jitted
+train/eval/decode step, letting GSPMD insert (and schedule) every
+resharding collective with full-program visibility — the
+whole-program-compilation thesis of Julia-to-TPU (PAPERS.md arXiv
+1810.09868) at the MLPerf-pods scale recipe (arXiv 1909.09756).
+
+The mapping from config dims to mesh axes goes through t5x-style
+*logical-axis rules*: each tensor dim of an op is classified by role —
+
+  ``sample``     the batch dim (dim 0; Sample in SOAP),
+  ``parameter``  a dim whose partitioning splits a weight
+                 (``Parameter``) — derived from each weight's
+                 ``partition_dims`` mapping,
+  ``attribute``  any other tensor dim (``Attribute``),
+
+and the rules say which *mesh axis classes* a role may land on, in
+preference order.  On a hybrid ICI×DCN mesh
+(``parallel/distributed.hybrid_machine``: axes ``("dcn", "m0", ...)``)
+the default rules keep every non-sample dim on ICI axes, spilling onto
+``dcn`` only when the degree is otherwise inexpressible — so the
+gradient all-reduce stays the only DCN-crossing collective, which is
+exactly what the machine model's DCN surcharge
+(``simulator/machine.TPUMachineModel.dcn_spill_time``) steers the
+search toward.
+
+On a non-hybrid mesh (no ``dcn`` axis — every CPU tier-1 test) the
+role-aware assignment degenerates to precisely
+``parallel.mesh.Machine.axes_for_degrees``'s greedy walk, so the
+lowered step's constraints are bitwise-identical to per-op dispatch.
+
+Module-import contract: this file imports NO jax at module scope — the
+simulator's machine model calls the pure assignment helpers below and
+must stay importable without an accelerator runtime.  Everything
+jax-bound (``GraphLowering``, ``pjit_with_cpu_fallback``) imports jax
+lazily.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# -- roles and rules ---------------------------------------------------
+
+SAMPLE = "sample"
+PARAMETER = "parameter"
+ATTRIBUTE = "attribute"
+
+DCN_AXIS = "dcn"
+
+# (role, axis-class preference) pairs, t5x LogicalAxisRules-style.  Axis
+# classes: "ici" = every non-dcn mesh axis, "dcn" = the cross-host axis.
+# A role whose preference list omits "dcn" may still spill onto it as a
+# legality fallback — the spill is *recorded* (GraphLowering.dcn_spill,
+# doctor WARN, simulator surcharge) rather than forbidden, because a
+# degree the mesh cannot express intra-host must still lower.
+LogicalAxisRules = Sequence[Tuple[str, Tuple[str, ...]]]
+
+DEFAULT_AXIS_RULES: LogicalAxisRules = (
+    (SAMPLE, ("dcn", "ici")),      # batch may span hosts: grad all-reduce
+    (PARAMETER, ("ici",)),         # weight shards stay intra-host
+    (ATTRIBUTE, ("ici",)),         # activation splits stay intra-host
+)
+
+
+def rules_preference(rules: LogicalAxisRules, role: str) -> Tuple[str, ...]:
+    for r, pref in rules:
+        if r == role:
+            return tuple(pref)
+    return ("ici",)
+
+
+# -- knob parsing ------------------------------------------------------
+
+_TRUE = ("1", "true", "on", "yes")
+_FALSE = ("0", "false", "off", "no")
+_AUTO = ("", "auto")
+
+
+def lowered_from_env() -> Optional[bool]:
+    """Parse ``FF_LOWERED``: True/False, or None for auto/unset.
+    Loud on garbage — a silently ignored knob on a pod run would fall
+    back to per-op dispatch and quietly cost the fusion win."""
+    raw = os.environ.get("FF_LOWERED")
+    if raw is None:
+        return None
+    v = raw.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    if v in _AUTO:
+        return None
+    raise ValueError(
+        f"FF_LOWERED={raw!r} is not a valid setting (use 1/0/true/false/"
+        f"on/off/auto; empty or unset = auto)")
+
+
+def resolve_lowered(cfg_lowered: Optional[bool], num_nodes: int,
+                    process_count: int) -> bool:
+    """Effective lowering switch: explicit ``FFConfig.lowered`` wins,
+    then ``FF_LOWERED``, then auto — on exactly when the run spans
+    nodes/processes (the regime where whole-graph compilation is the
+    difference between a pod and a space heater)."""
+    if cfg_lowered is not None:
+        if not isinstance(cfg_lowered, bool):
+            raise ValueError(
+                f"FFConfig.lowered must be True, False, or None (auto); "
+                f"got {cfg_lowered!r}")
+        return cfg_lowered
+    env = lowered_from_env()
+    if env is not None:
+        return env
+    return num_nodes > 1 or process_count > 1
+
+
+# -- pure mesh-layout helpers (jax-free) -------------------------------
+
+def _prime_factors(n: int) -> List[int]:
+    # Same factorization parallel.mesh / parallel.distributed use —
+    # duplicated here (6 lines) so the simulator can import this module
+    # without pulling jax in through mesh.py.
+    out: List[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
+def hybrid_axis_layout(num_devices: int, num_hosts: int
+                       ) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    """(axis_names, axis_sizes) of the mesh ``hybrid_machine``/``Machine``
+    would build for this device count — the pure shadow of the real mesh,
+    used by the simulator to ask "where would this degree land?" without
+    constructing devices."""
+    n = int(num_devices)
+    h = int(num_hosts)
+    if h <= 1 or n % h != 0:
+        factors = _prime_factors(n) if n > 1 else [1]
+        return (tuple(f"m{i}" for i in range(len(factors))), tuple(factors))
+    per = n // h
+    ici = tuple(_prime_factors(per)) if per > 1 else (1,)
+    return ((DCN_AXIS,) + tuple(f"m{i}" for i in range(len(ici))),
+            (h,) + ici)
+
+
+def dim_roles(op, rank: int) -> Tuple[str, ...]:
+    """Per-tensor-dim SOAP role for an op's output: dim 0 is ``sample``;
+    a dim that any weight's ``partition_dims`` shards with is
+    ``parameter``; the rest are ``attribute``."""
+    roles = [ATTRIBUTE] * rank
+    if rank > 0:
+        roles[0] = SAMPLE
+    w_op = getattr(op, "share_from", None) or op
+    for w in getattr(w_op, "weights", ()):
+        for pd in (w.partition_dims or ()):
+            if pd is not None and 0 < pd < rank:
+                roles[pd] = PARAMETER
+    return tuple(roles)
+
+
+def assign_axes(axis_names: Sequence[str], axis_sizes: Sequence[int],
+                degrees: Sequence[int],
+                roles: Optional[Sequence[str]] = None,
+                rules: LogicalAxisRules = DEFAULT_AXIS_RULES,
+                ) -> Tuple[List[Tuple[str, ...]], Tuple[Tuple[int, int], ...]]:
+    """Role-aware version of ``Machine.axes_for_degrees``: assign disjoint
+    mesh-axis groups whose sizes multiply to each requested degree.
+
+    Sample dims claim axes first (so the batch takes ``dcn`` + the widest
+    ICI axes, matching the hybrid mesh's leading-batch-axis design); the
+    remaining dims walk in index order, preferring the axis classes their
+    role's rule names and spilling onto the rest only when the degree is
+    otherwise inexpressible.  Returns ``(groups, spill)`` where ``spill``
+    lists ``(dim, dcn_share)`` for every non-sample dim that had to take
+    the ``dcn`` axis (dcn_share = the part of its degree crossing hosts).
+
+    When no ``dcn`` axis exists, this is step-for-step identical to
+    ``Machine.axes_for_degrees`` — the bitwise-parity anchor for the
+    lowered path on the CPU test mesh.  Raises ValueError (same message
+    shape) when a degree cannot be composed at all.
+    """
+    if roles is None:
+        roles = [SAMPLE if i == 0 else ATTRIBUTE
+                 for i in range(len(degrees))]
+    remaining: List[Tuple[Optional[str], int]] = list(
+        zip(axis_names, axis_sizes))
+    groups: List[Optional[Tuple[str, ...]]] = [None] * len(degrees)
+    spill: List[Tuple[int, int]] = []
+    order = ([i for i, r in enumerate(roles) if r == SAMPLE]
+             + [i for i, r in enumerate(roles) if r != SAMPLE])
+    for i in order:
+        need = int(degrees[i])
+        pref = rules_preference(rules, roles[i])
+        group: List[str] = []
+        dcn_share = 1
+        # pass 1: only axis classes the rule names; pass 2: everything
+        # (legality fallback — records a spill for dcn takes).
+        for allowed in (pref, None):
+            for j in range(len(remaining)):
+                name, size = remaining[j]
+                if name is None:
+                    continue
+                cls = DCN_AXIS if name == DCN_AXIS else "ici"
+                if allowed is not None and cls not in allowed:
+                    continue
+                if need % size == 0:
+                    group.append(name)
+                    need //= size
+                    remaining[j] = (None, 0)
+                    if cls == DCN_AXIS and DCN_AXIS not in pref:
+                        dcn_share *= size
+                    if need == 1:
+                        break
+            if need == 1:
+                break
+        if need != 1:
+            raise ValueError(
+                f"partition degree {degrees[i]} not expressible over mesh "
+                f"axes {dict(zip(axis_names, axis_sizes))} "
+                f"(degrees={list(degrees)})")
+        if dcn_share > 1:
+            spill.append((i, dcn_share))
+        groups[i] = tuple(group)
+    return [g if g is not None else () for g in groups], tuple(sorted(spill))
+
+
+def spec_entries(groups: Sequence[Tuple[str, ...]]) -> List:
+    """Axis groups → PartitionSpec entries, matching
+    ``Machine.spec_for_config``'s shape exactly (scalar for singleton
+    groups, None for unsharded, trailing Nones trimmed)."""
+    entries = [g if len(g) > 1 else (g[0] if g else None) for g in groups]
+    entries = [e if e else None for e in entries]
+    while entries and entries[-1] is None:
+        entries.pop()
+    return entries
+
+
+def spec_string(groups: Sequence[Tuple[str, ...]]) -> str:
+    """Human/sidecar rendering of a lowered spec, e.g.
+    ``"('dcn','m0'), None, 'm1'"`` — stable across jax versions (no
+    PartitionSpec repr dependency)."""
+    parts = []
+    for e in spec_entries(groups):
+        if e is None:
+            parts.append("None")
+        elif isinstance(e, tuple):
+            parts.append("(" + ",".join(f"'{a}'" for a in e) + ")")
+        else:
+            parts.append(f"'{e}'")
+    return ", ".join(parts) if parts else "replicated"
+
+
+# -- jax-bound: the pjit wrapper and the lowering object ---------------
+
+def pjit_with_cpu_fallback(fun, in_shardings=None, out_shardings=None,
+                           static_argnums=(), donate_argnums=()):
+    """t5x-style wrapper (SNIPPETS.md): on CPU — every tier-1 test —
+    drop the explicit arg shardings and let plain ``jax.jit`` + the
+    in-graph constraints do the work, so the CPU path is byte-identical
+    to per-op dispatch (same jit call, same cache keys); elsewhere pass
+    the shardings through so pjit places arguments without a host round
+    trip."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return jax.jit(fun, static_argnums=static_argnums,
+                       donate_argnums=donate_argnums)
+    return jax.jit(fun, in_shardings=in_shardings,
+                   out_shardings=out_shardings,
+                   static_argnums=static_argnums,
+                   donate_argnums=donate_argnums)
+
+
+class GraphLowering:
+    """Per-op sharding plan for ONE whole-graph jitted step.
+
+    Built once at compile() from the resolved strategy map; the step
+    builders ask it for constraints (op outputs) and for the jit wrapper
+    (``jit_step``).  Also the introspection surface: ``plan()`` feeds the
+    sidecar stamp, ``dcn_spill`` feeds doctor's WARN.
+    """
+
+    def __init__(self, machine, ops, rules: LogicalAxisRules = DEFAULT_AXIS_RULES):
+        self.machine = machine
+        self.rules = rules
+        self._roles: Dict[str, Tuple[str, ...]] = {}
+        self._ops: Dict[str, object] = {}
+        for op in ops:
+            self._roles[op.name] = dim_roles(op, op.output.num_dims)
+            self._ops[op.name] = op
+        # (degrees, roles) -> (PartitionSpec, spill) — shared across ops
+        # with identical shapes/strategies.
+        self._spec_cache: Dict[tuple, tuple] = {}
+
+    # -- spec derivation ---------------------------------------------------
+    def _padded(self, op, pc, rank: int) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        degrees = list(pc.dims)
+        roles = list(self._roles.get(op.name) or
+                     dim_roles(op, len(degrees)))
+        if len(degrees) < rank:
+            degrees += [1] * (rank - len(degrees))
+        degrees = degrees[:rank]
+        if len(roles) < rank:
+            roles += [ATTRIBUTE] * (rank - len(roles))
+        roles = roles[:rank]
+        if roles and rank > 0:
+            roles[0] = SAMPLE
+        return tuple(degrees), tuple(roles)
+
+    def spec_for(self, op, pc, rank: Optional[int] = None):
+        """PartitionSpec for an op output of ``rank`` under ``pc`` —
+        the lowered analogue of ``Machine.spec_for_config``."""
+        from jax.sharding import PartitionSpec
+
+        degrees, roles = self._padded(op, pc, rank if rank is not None
+                                      else len(pc.dims))
+        key = (degrees, roles)
+        hit = self._spec_cache.get(key)
+        if hit is None:
+            groups, spill = assign_axes(self.machine.axis_names,
+                                        self.machine.axis_sizes,
+                                        degrees, roles, self.rules)
+            hit = (PartitionSpec(*spec_entries(groups)), spill,
+                   spec_string(groups))
+            self._spec_cache[key] = hit
+        return hit[0]
+
+    def constraint(self, x, op):
+        """Sharding constraint for an op's output inside the whole-graph
+        step — same call shape as ``Machine.constraint`` but routed
+        through the logical-axis rules."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        pc = op.constraint_pc()
+        spec = self.spec_for(op, pc, rank=x.ndim)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.machine.mesh, spec))
+
+    def jit_step(self, fun, static_argnums=(), donate_argnums=()):
+        """Jit a whole-graph step with the CPU-fallback wrapper.  Arg
+        shardings are left for GSPMD to infer from the constraints — the
+        step closes over per-op ``with_sharding_constraint``s, which is
+        the authoritative placement."""
+        return pjit_with_cpu_fallback(fun, static_argnums=static_argnums,
+                                      donate_argnums=donate_argnums)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def dcn_spill(self) -> Dict[str, Tuple[Tuple[int, int], ...]]:
+        """{op_name: ((dim, dcn_share), ...)} for every op whose resolved
+        spec puts a non-sample dim (partly) on the ``dcn`` axis — the
+        thing the search's DCN surcharge exists to prevent."""
+        out: Dict[str, Tuple[Tuple[int, int], ...]] = {}
+        for name, op in self._ops.items():
+            pc = getattr(op, "pc", None)
+            if pc is None:
+                continue
+            self.spec_for(op, op.constraint_pc(), rank=op.output.num_dims)
+            degrees, roles = self._padded(op, op.constraint_pc(),
+                                          op.output.num_dims)
+            spill = self._spec_cache[(degrees, roles)][1]
+            if spill:
+                out[name] = spill
+        return out
+
+    def plan(self) -> Dict[str, Dict[str, object]]:
+        """Resolved per-op lowering plan for the provenance sidecar:
+        ``{op: {spec, roles, dcn_spill}}``."""
+        out: Dict[str, Dict[str, object]] = {}
+        for name, op in self._ops.items():
+            pc = getattr(op, "pc", None)
+            if pc is None:
+                continue
+            rank = op.output.num_dims
+            self.spec_for(op, op.constraint_pc(), rank=rank)
+            degrees, roles = self._padded(op, op.constraint_pc(), rank)
+            _, spill, rendered = self._spec_cache[(degrees, roles)]
+            row: Dict[str, object] = {"spec": rendered,
+                                      "roles": "".join(r[0] for r in roles)}
+            if spill:
+                row["dcn_spill"] = [list(s) for s in spill]
+            out[name] = row
+        return out
+
+    def __repr__(self):
+        mesh = dict(zip(self.machine.axis_names, self.machine.axis_sizes))
+        return f"GraphLowering(ops={len(self._ops)}, mesh={mesh})"
+
+
+def maybe_lowering(model) -> Optional[GraphLowering]:
+    """The model's GraphLowering when the knob resolves on, else None.
+    Called from ``FFModel._compile_impl`` after the machine and per-op
+    configs are resolved."""
+    import jax
+
+    cfg = model.config
+    on = resolve_lowered(getattr(cfg, "lowered", None), cfg.num_nodes,
+                         jax.process_count())
+    if not on:
+        return None
+    return GraphLowering(model.machine, model.ops)

@@ -570,9 +570,25 @@ class CostModel:
                 self.stats[stat] += 1
             return t
         t, stat = self._op_time_slow(op, pc, which)
+        t += self._dcn_penalty(op, pc)
         self._fast[fk] = (t, stat)
         self._fast_ops[id(op)] = op
         return t
+
+    def _dcn_penalty(self, op, pc) -> float:
+        """Hierarchical-mesh surcharge: when a non-sample dim of this
+        config would land on the ``dcn`` axis of the machine's hybrid
+        mesh, the lowered step reshards this op's part across hosts
+        every step — charge it at DCN bandwidth so the search keeps
+        gradient all-reduce as the only DCN-crossing collective.  Added
+        OUTSIDE the shape-keyed measured/analytic caches (those are
+        placement-blind) and INSIDE the shared (op, pc) fast memo, so
+        the full and delta simulators price it identically."""
+        if pc is None or pc.host_placed:
+            return 0.0
+        sub = self._sub_output_shape(op, pc)
+        part_bytes = self._dtype_bytes * float(np.prod(sub))
+        return self.machine.dcn_spill_time(pc.dims, part_bytes)
 
     def _op_time_slow(self, op, pc, which: str):
         """Returns (time, stats counter a repeat call would bump)."""

@@ -82,6 +82,9 @@ class TPUMachineModel:
         while x > 1 and n % x != 0:
             x -= 1
         self.torus = (max(1, x), n // max(1, x))
+        # degree-vector -> dcn_spill result; the search's delta loop
+        # re-asks for thousands of candidate configs
+        self._spill_cache: Dict[Tuple[int, ...], Tuple[Tuple[int, int], ...]] = {}
 
     def coord(self, dev: int) -> Tuple[int, int]:
         return (dev % self.torus[0], dev // self.torus[0])
@@ -119,3 +122,46 @@ class TPUMachineModel:
         if not all(self.same_host(devices[0], d) for d in devices):
             bw = self.dcn_bandwidth
         return 2.0 * (n - 1) / n * num_bytes / bw
+
+    # -- hierarchical-mesh placement (whole-graph lowering) ----------------
+    @property
+    def num_hosts(self) -> int:
+        return max(1, -(-self.num_devices // self.chips_per_host))
+
+    def dcn_spill(self, degrees) -> Tuple[Tuple[int, int], ...]:
+        """Non-sample dims of a partition-degree vector that the lowering
+        pass (parallel/lowering.py) would have to place on the ``dcn``
+        axis of this machine's hybrid mesh — ``((dim, dcn_share), ...)``,
+        empty on a single-host machine or when every non-sample degree
+        fits the ICI axes.  Pure shadow of ``GraphLowering``'s assignment:
+        lowering.py is jax-free at module scope precisely so the
+        simulator can ask this without an accelerator runtime."""
+        if self.num_hosts <= 1 or self.num_devices % self.chips_per_host:
+            return ()
+        key = tuple(degrees)
+        hit = self._spill_cache.get(key)
+        if hit is not None:
+            return hit
+        from ..parallel.lowering import assign_axes, hybrid_axis_layout
+
+        names, sizes = hybrid_axis_layout(self.num_devices, self.num_hosts)
+        try:
+            _, spill = assign_axes(names, sizes, key)
+        except ValueError:
+            # inexpressible degrees never reach execution (legalize_pc
+            # clamps first) — charge nothing rather than guess
+            spill = ()
+        self._spill_cache[key] = spill
+        return spill
+
+    def dcn_spill_time(self, degrees, part_bytes: float) -> float:
+        """Seconds of DCN traffic a strategy pays per step because a
+        non-sample dim crossed hosts: each spilled dim reshards the
+        part's bytes over the ``dcn`` axis (ring factor), instead of the
+        gradient all-reduce being the only DCN-crossing collective.
+        This is the search pressure that keeps lowered strategies
+        pod-shaped."""
+        t = 0.0
+        for _dim, share in self.dcn_spill(degrees):
+            t += 2.0 * (share - 1) / share * part_bytes / self.dcn_bandwidth
+        return t

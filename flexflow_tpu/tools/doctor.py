@@ -511,6 +511,88 @@ def _perf(probe: bool):
     return ", ".join(bits)
 
 
+def _lowering_check():
+    # Whole-graph lowering (parallel/lowering.py): loud FF_LOWERED parse,
+    # a probe-lower of a tiny seeded model on the CPU mesh (bitwise
+    # against per-op dispatch), and a WARN whenever a strategy would put
+    # a non-sample dim on the hybrid mesh's ``dcn`` axis — the placement
+    # the search's DCN surcharge exists to prevent.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import flexflow_tpu as ff
+    from ..parallel import lowering as low
+
+    env = low.lowered_from_env()  # ValueError on garbage — required-loud
+    eff = low.resolve_lowered(None, 1, jax.process_count())
+    bits = [f"FF_LOWERED={'auto' if env is None else env} "
+            f"(effective {'on' if eff else 'off'} on this host)"]
+
+    def probe(flag):
+        cfg = ff.FFConfig(batch_size=8, lowered=flag)
+        m = ff.FFModel(cfg)
+        inp = m.create_tensor((8, 8), nchw=False, name="x")
+        t = m.dense(inp, 16, activation="relu", name="fc1")
+        t = m.dense(t, 4, name="fc2")
+        m.softmax(t, name="sm")
+        m.compile(ff.SGDOptimizer(lr=0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"])
+        m.init_layers(seed=0)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 8), dtype=np.float32)
+        y = rng.integers(0, 4, size=(8, 1), dtype=np.int32)
+        m.set_batch({inp: x}, y)
+        m.train_iteration()
+        m.sync()
+        return m
+
+    ml = probe(True)
+    assert ml._lowering is not None, "probe model did not lower"
+    md = probe(False)
+    a = np.asarray(jax.device_get(ml.get_parameter("fc2", "kernel")))
+    b = np.asarray(jax.device_get(md.get_parameter("fc2", "kernel")))
+    assert np.array_equal(a, b), "lowered probe diverged from dispatch"
+    bits.append("probe-lower: 1-step train bitwise == per-op dispatch")
+    spill = ml._lowering.dcn_spill
+    if spill:
+        bits.append(f"WARN: dcn axis carries non-sample dims here: {spill}")
+
+    # Shipped strategies audited against the pod-shaped mesh shadow for
+    # their recorded device count (2+ hosts at 8 chips/host).
+    from ..parallel.strategy import (DEFAULT_STRATEGY_DIR,
+                                     load_strategies_from_file,
+                                     read_provenance)
+    from ..simulator.machine import TPUMachineModel
+
+    warns = []
+    if os.path.isdir(DEFAULT_STRATEGY_DIR):
+        for fn in sorted(os.listdir(DEFAULT_STRATEGY_DIR)):
+            if not fn.endswith(".pb"):
+                continue
+            path = os.path.join(DEFAULT_STRATEGY_DIR, fn)
+            try:
+                nd = int((read_provenance(path) or {}).get("num_devices", 0))
+                strategies = load_strategies_from_file(path)
+            except Exception:
+                continue
+            if nd <= 0:
+                continue
+            mm = TPUMachineModel(num_devices=nd)
+            spilled = [op for op, pc in sorted(strategies.items())
+                       if mm.dcn_spill(pc.dims)]
+            if spilled:
+                warns.append(f"{fn}: {', '.join(spilled)}")
+    if warns:
+        bits.append("WARN: non-sample dims would land on the dcn axis "
+                    "(a lowered pod run reshards these over DCN every "
+                    "step): " + "; ".join(warns))
+    else:
+        bits.append("shipped strategies: no non-sample dcn placement")
+    return ", ".join(bits)
+
+
 def _cpu_train():
     import jax
 
@@ -565,6 +647,7 @@ def main(argv: Optional[List[str]] = None) -> int:
              ("reconfiguration", _reconfiguration, False),
              ("serving", _serving, False),
              ("autoscaler", _autoscaler, False),
+             ("lowering", _lowering_check, False),
              ("cpu training", _cpu_train, True)]
 
     # print each line as its check completes — the slow checks (90s

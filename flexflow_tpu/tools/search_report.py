@@ -188,6 +188,19 @@ def _op_ms(meta: Optional[Dict[str, Any]], op: str) -> Optional[float]:
         return None
 
 
+def _op_spec(meta: Optional[Dict[str, Any]], op: str) -> Optional[str]:
+    """Resolved sharding spec for an op: the attribution row's ``spec``
+    (stamped by every new sidecar), else the lowering plan's entry when
+    the sidecar came from a lowered compile."""
+    for section in ("ops", "lowering"):
+        rows = (meta or {}).get(section)
+        if isinstance(rows, dict) and isinstance(rows.get(op), dict):
+            s = rows[op].get("spec")
+            if isinstance(s, str):
+                return s
+    return None
+
+
 def _engine_order(events: Dict[str, List[Dict[str, Any]]]) -> List[str]:
     order: List[str] = []
     for kind in ("search_start", "search_summary", "search_candidate"):
@@ -455,6 +468,8 @@ def render_diff(a_path: str, b_path: str) -> str:
                 bits.append(f"{key} {meta[key]}")
         if "best_ms" in meta:
             bits.append(f"best {_ms(meta['best_ms'])} ms")
+        if meta.get("lowered"):
+            bits.append("lowered")
         lines.append("- " + " · ".join(bits))
     lines.append("")
 
@@ -500,6 +515,17 @@ def render_diff(a_path: str, b_path: str) -> str:
                          f"{total_b:.3f} ms ({total_b - total_a:+.3f} ms; "
                          f"per-op sums ignore overlap — totals below are "
                          f"the authority)")
+        spec_rows = []
+        for op in changed:
+            sa, sb = _op_spec(a_meta, op), _op_spec(b_meta, op)
+            if sa is not None or sb is not None:
+                spec_rows.append((op, sa or "—", sb or "—"))
+        if spec_rows:
+            lines.append("")
+            lines.append("## Sharding-spec changes (lowered mesh axes)")
+            lines.append("")
+            for op, sa, sb in spec_rows:
+                lines.append(f"- {op}: `{sa}` -> `{sb}`")
     best_a = (a_meta or {}).get("best_ms")
     best_b = (b_meta or {}).get("best_ms")
     if best_a is not None and best_b is not None:

@@ -48,6 +48,54 @@ echo "$MEMREPORT" | grep -q "headroom: \*\*" \
   || { echo "memory smoke: report missing headroom line"; exit 1; }
 echo "telemetry+health+memory smoke: OK ($(wc -l < "$TRACE") trace records)"
 
+# Lowering smoke: the whole-graph lowered step (FF_LOWERED=1) must be
+# BITWISE-identical to per-op dispatch on a hybrid SOAP strategy, and
+# bench.py --lowered must land a lowering_speedup perf-ledger entry
+# (docs/lowering.md).
+python - <<'EOF' \
+  || { echo "lowering smoke: lowered/dispatch parity failed"; exit 1; }
+import numpy as np
+import flexflow_tpu as ff
+
+def run(lowered):
+    strategies = {"fc1": ff.ParallelConfig(dims=(2, 4)),
+                  "fc2": ff.ParallelConfig(dims=(8, 1)),
+                  "sm": ff.ParallelConfig(dims=(8, 1))}
+    cfg = ff.FFConfig(batch_size=16, strategies=strategies, lowered=lowered)
+    m = ff.FFModel(cfg)
+    inp = m.create_tensor((16, 8), nchw=False)
+    t = m.dense(inp, 16, activation=ff.ActiMode.RELU, name="fc1")
+    m.softmax(m.dense(t, 4, name="fc2"), name="sm")
+    m.compile(ff.SGDOptimizer(lr=0.1),
+              "sparse_categorical_crossentropy", ["accuracy"])
+    m.init_layers(seed=0)
+    assert (m._lowering is not None) is lowered, m._lowering
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((16, 8), np.float32)
+    y = rng.integers(0, 4, (16, 1), dtype=np.int32)
+    m.set_batch({inp: x}, y)
+    for _ in range(2):
+        m.train_iteration()
+    m.sync()
+    return np.asarray(m.get_parameter("fc1", "kernel"))
+
+a, b = run(False), run(True)
+assert np.array_equal(a, b), np.abs(a - b).max()
+print("lowering parity: bitwise OK")
+EOF
+LOWERED_LEDGER="$SMOKE_DIR/lowered_ledger.jsonl"
+FF_BENCH_LOWERED_BATCH=8 FF_BENCH_LOWERED_STEPS=2 \
+  FF_PERF_LEDGER="$LOWERED_LEDGER" \
+  python bench.py --lowered > "$SMOKE_DIR/bench_lowered.out" \
+  || { echo "lowering smoke: bench.py --lowered exited non-zero"; exit 1; }
+grep -q '"metric": "lowering_speedup"' "$LOWERED_LEDGER" \
+  || { echo "lowering smoke: no lowering_speedup ledger entry"; exit 1; }
+echo "lowering smoke: OK ($(python -c "
+import json
+lines = [l for l in open('$SMOKE_DIR/bench_lowered.out') if l.strip().startswith('{')]
+r = json.loads(lines[-1])
+print(f\"{r['value']}x lowered/dispatch ({r['backend']})\")"))"
+
 # Degradation-ladder smoke: with no chip attached, bench.py must DEGRADE
 # (CPU proxy metric stamped proxy:true, rc=0, a parseable perf-ledger
 # entry) instead of dying — the "bench never returns rc=1 without a
